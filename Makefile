@@ -1,0 +1,19 @@
+# Dev workflow (reference analog: Makefile targets test-integration etc.)
+
+# CPU test env: 8 virtual devices, no TPU-relay plugin registration
+# (PALLAS_AXON_POOL_IPS= disables the axon sitecustomize hook so test
+# processes never dial the single-client TPU tunnel).
+TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+.PHONY: test
+test:
+	$(TEST_ENV) python -m pytest tests/ -x -q
+
+.PHONY: test-fast
+test-fast:
+	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"
+
+.PHONY: bench
+bench:
+	python bench.py

@@ -1,0 +1,103 @@
+"""Headline benchmark: llama-architecture causal-LM training throughput on one
+TPU chip (tokens/sec/chip and MFU).
+
+The reference publishes no perf numbers (BASELINE.md); the north-star target
+from BASELINE.json is a llama fine-tune at >=35% MFU. This bench runs the
+full training step (fwd+bwd+adamw, remat, bf16 compute) on the largest
+single-chip-friendly llama config and reports MFU vs the 0.35 target:
+vs_baseline = MFU / 0.35 (>1.0 beats the target).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOP/s per chip by TPU generation (dense).
+PEAK_BF16 = {
+    "v5 lite": 197e12,  # v5e
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "cpu": 1e12,  # nominal, so the bench still runs off-TPU
+}
+
+
+def chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_BF16.items():
+        if key in kind:
+            return val
+    return PEAK_BF16["cpu"]
+
+
+def main() -> None:
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.parallel.mesh import single_device_mesh
+    from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
+    from runbooks_tpu.train.step import create_train_state, make_train_step
+
+    device = jax.devices()[0]
+    on_tpu = "tpu" in getattr(device, "platform", "").lower() or "TPU" in str(device)
+
+    if on_tpu:
+        model, batch_size, seq = "bench-410m", 8, 2048
+        steps, warmup = 20, 3
+    else:  # CPU smoke so the bench is runnable anywhere
+        model, batch_size, seq = "debug", 4, 128
+        steps, warmup = 3, 1
+
+    cfg = get_config(model)
+    mesh = single_device_mesh()
+    opt = make_optimizer(OptimizerConfig(total_steps=10_000, warmup_steps=10))
+    state, shardings = create_train_state(cfg, opt, mesh, jax.random.key(0))
+    step = make_train_step(cfg, opt, mesh, shardings)
+
+    tokens = jax.random.randint(jax.random.key(1), (batch_size, seq + 1), 0,
+                                cfg.vocab_size)
+    batch = {
+        "tokens": tokens[:, :-1],
+        "targets": tokens[:, 1:],
+        "loss_mask": jnp.ones((batch_size, seq), jnp.float32),
+    }
+
+    with jax.set_mesh(mesh):
+        for _ in range(warmup):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    # Train FLOPs/token ~= 3x forward matmul FLOPs (bwd ~= 2x fwd).
+    train_flops_per_token = 3.0 * cfg.flops_per_token(seq)
+    achieved = tokens_per_sec * train_flops_per_token
+    peak = chip_peak_flops(device)
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": f"{model} train MFU (1 chip, bs{batch_size}x{seq}, bf16)",
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "step_time_s": round(dt / steps, 4),
+        "loss": round(float(metrics["loss"]), 4),
+        "device": str(device),
+    }))
+
+
+if __name__ == "__main__":
+    main()

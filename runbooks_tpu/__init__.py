@@ -1,0 +1,38 @@
+"""runbooks-tpu: a TPU-native ML orchestration + compute framework.
+
+Capability parity target: substratusai/runbooks (a Kubernetes operator turning
+Model/Dataset/Server/Notebook CRDs into container builds, bucket-backed
+artifacts, and accelerator workloads — see SURVEY.md). Unlike the reference,
+which delegates all ML compute to external CUDA/PyTorch containers, this
+framework ships a first-class JAX/XLA/Pallas compute layer designed for TPU:
+
+- ``runbooks_tpu.models``   — decoder-only transformer families (Llama, Falcon,
+  OPT/GPT) as functional JAX (pytree params, jit/pjit-friendly).
+- ``runbooks_tpu.ops``      — TPU kernels: Pallas flash attention, RMSNorm,
+  rotary embeddings, sampling; XLA fallbacks everywhere.
+- ``runbooks_tpu.parallel`` — device mesh construction, sharding rules
+  (DP/FSDP/TP/SP/EP), ring attention, jax.distributed bootstrap.
+- ``runbooks_tpu.train``    — pjit train step, optimizers, LoRA, orbax
+  checkpointing, packed-sequence data pipeline.
+- ``runbooks_tpu.serve``    — KV-cache inference engine with continuous
+  batching and an OpenAI-compatible /v1/completions HTTP API.
+
+The orchestration layer mirrors the reference's operator shape
+(declarative resources -> reconcilers -> container contract -> artifact
+buckets -> dev CLI), rebuilt TPU-first:
+
+- ``runbooks_tpu.api``        — Model/Dataset/Server/Notebook resource types +
+  conditions (reference: api/v1/*.go).
+- ``runbooks_tpu.controller`` — reconcilers (reference: internal/controller/).
+- ``runbooks_tpu.cloud``      — cloud abstraction + TPU resource/topology
+  mapping and multi-host pod-slice fan-out (reference: internal/cloud/,
+  internal/resources/).
+- ``runbooks_tpu.sci``        — Substratus Cloud Interface equivalent: signed
+  URLs, object MD5, identity binding (reference: internal/sci/).
+- ``runbooks_tpu.k8s``        — minimal Kubernetes REST client + an in-memory
+  fake API server for envtest-style tests.
+- ``runbooks_tpu.cli``        — the ``rbt`` dev CLI (reference: cmd/sub/,
+  internal/cli/, internal/tui/).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,22 @@
+"""`rbt` — the runbooks-tpu dev CLI (reference analog: cmd/sub, internal/cli).
+
+Round-1 stub: subcommands land with the orchestration layer (apply/run/
+serve/get/delete/notebook).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    sys.stderr.write(
+        "rbt: CLI subcommands (apply/run/serve/get/delete/notebook) are "
+        "under construction in this round.\n"
+    )
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

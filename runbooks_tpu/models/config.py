@@ -1,0 +1,185 @@
+"""Model architecture configs for the decoder-only transformer families.
+
+The reference framework ships no model code at all — it schedules external
+CUDA/PyTorch containers for families documented in its examples/ tree
+(reference: examples/llama2-7b/finetuned-model.yaml, examples/falcon-40b/
+server.yaml, examples/facebook-opt-125m/base-model.yaml). Here those families
+are first-class: one `ModelConfig` describes any of them, and
+`runbooks_tpu.models.transformer` consumes it.
+
+All sizes chosen to map well onto the TPU MXU (multiples of 128 where the
+family allows it); dtypes default to bfloat16 params/activations with float32
+logits/softmax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a decoder-only transformer."""
+
+    name: str = "custom"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32            # < num_heads => GQA; == 1 => MQA
+    head_dim: int = 128
+    max_seq_len: int = 4096
+
+    # Normalization
+    norm_type: str = "rmsnorm"        # "rmsnorm" | "layernorm"
+    norm_eps: float = 1e-5
+
+    # MLP
+    gated_mlp: bool = True            # SwiGLU-style gate (llama) vs plain MLP
+    activation: str = "silu"          # "silu" | "gelu" | "relu"
+    mlp_bias: bool = False
+
+    # Attention
+    attn_bias: bool = False
+    qk_norm: bool = False
+    logit_softcap: Optional[float] = None
+
+    # Positional encoding
+    position_type: str = "rope"       # "rope" | "alibi" | "learned"
+    rope_theta: float = 10000.0
+
+    # Block structure
+    parallel_block: bool = False      # falcon/gpt-neox parallel attn+mlp
+    shared_layer_norm: bool = True    # for parallel_block: one LN feeds both
+
+    # Embeddings / head
+    tie_embeddings: bool = False
+    embed_scale: bool = False         # multiply embeddings by sqrt(hidden)
+
+    # Dtypes
+    dtype: str = "bfloat16"           # activation dtype
+    param_dtype: str = "float32"      # master param dtype
+
+    # Training-time behavior
+    remat_policy: str = "nothing_saveable"  # see train/step.py
+
+    @property
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def parameter_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def num_params(self) -> int:
+        """Parameter count (embedding included once if tied)."""
+        h, v = self.hidden_size, self.vocab_size
+        embed = v * h
+        head = 0 if self.tie_embeddings else v * h
+        pos = v * 0
+        if self.position_type == "learned":
+            pos = self.max_seq_len * h
+        attn = h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
+        if self.attn_bias:
+            attn += self.q_dim + 2 * self.kv_dim + h
+        mlp_mats = (2 if self.gated_mlp else 1) * h * self.intermediate_size
+        mlp_mats += self.intermediate_size * h
+        if self.mlp_bias:
+            mlp_mats += (2 if self.gated_mlp else 1) * self.intermediate_size + h
+        norms_per_layer = h if (self.parallel_block and self.shared_layer_norm) else 2 * h
+        if self.norm_type == "layernorm":
+            norms_per_layer *= 2  # scale + bias
+        per_layer = attn + mlp_mats + norms_per_layer
+        final_norm = h * (2 if self.norm_type == "layernorm" else 1)
+        return embed + head + pos + self.num_layers * per_layer + final_norm
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Forward-pass matmul FLOPs per token (2*N plus attention quadratic).
+
+        Used for MFU accounting (train step multiplies by 3 for fwd+bwd).
+        """
+        s = seq_len or self.max_seq_len
+        h = self.hidden_size
+        attn_proj = 2 * (h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h)
+        attn_scores = 2 * 2 * s * self.q_dim  # QK^T and PV, per token
+        mlp = 2 * ((2 if self.gated_mlp else 1) * h * self.intermediate_size
+                   + self.intermediate_size * h)
+        per_layer = attn_proj + attn_scores + mlp
+        head = 2 * h * self.vocab_size
+        return float(self.num_layers * per_layer + head)
+
+
+def _llama(name, v=32000, h=4096, i=11008, l=32, q=32, kv=32, d=128, s=4096,
+           theta=10000.0):
+    return ModelConfig(
+        name=name, vocab_size=v, hidden_size=h, intermediate_size=i,
+        num_layers=l, num_heads=q, num_kv_heads=kv, head_dim=d, max_seq_len=s,
+        norm_type="rmsnorm", norm_eps=1e-5, gated_mlp=True, activation="silu",
+        position_type="rope", rope_theta=theta,
+    )
+
+
+def _falcon(name, v=65024, h=4544, l=32, q=71, kv=71, s=2048):
+    # Falcon: parallel attention+MLP block, layernorm, no gate, GELU,
+    # rotary embeddings, biases off for matmuls but LN has bias.
+    return ModelConfig(
+        name=name, vocab_size=v, hidden_size=h, intermediate_size=4 * h,
+        num_layers=l, num_heads=q, num_kv_heads=kv, head_dim=h // q,
+        max_seq_len=s, norm_type="layernorm", norm_eps=1e-5, gated_mlp=False,
+        activation="gelu", position_type="rope", parallel_block=True,
+        tie_embeddings=True,
+    )
+
+
+def _opt(name, v=50272, h=768, i=3072, l=12, q=12, s=2048):
+    return ModelConfig(
+        name=name, vocab_size=v, hidden_size=h, intermediate_size=i,
+        num_layers=l, num_heads=q, num_kv_heads=q, head_dim=h // q,
+        max_seq_len=s, norm_type="layernorm", norm_eps=1e-5, gated_mlp=False,
+        activation="relu", position_type="learned", attn_bias=True,
+        mlp_bias=True, tie_embeddings=True,
+    )
+
+
+# Registry mirrors the reference's documented example configs
+# (reference: examples/ tree — llama2-7b, llama2-70b, falcon-7b/40b,
+# facebook-opt-125m) plus debug sizes for tests/benchmarks.
+CONFIGS = {
+    # Llama-2 family (reference: examples/llama2-7b, examples/llama2-70b)
+    "llama2-7b": _llama("llama2-7b"),
+    "llama2-13b": _llama("llama2-13b", h=5120, i=13824, l=40, q=40, kv=40, d=128),
+    "llama2-70b": _llama("llama2-70b", h=8192, i=28672, l=80, q=64, kv=8, d=128),
+    # Llama-3-ish long-context config (net-new capability; SURVEY.md §5.7)
+    "llama3-8b": _llama("llama3-8b", v=128256, h=4096, i=14336, l=32, q=32,
+                        kv=8, d=128, s=8192, theta=500000.0),
+    # Falcon family (reference: examples/falcon-7b-instruct, examples/falcon-40b)
+    "falcon-7b": _falcon("falcon-7b"),
+    "falcon-40b": _falcon("falcon-40b", h=8192, l=60, q=128, kv=8),
+    # OPT (reference: examples/facebook-opt-125m — the CPU smoke model)
+    "opt-125m": _opt("opt-125m"),
+    "opt-1.3b": _opt("opt-1.3b", h=2048, i=8192, l=24, q=32),
+    # Debug/bench sizes
+    "debug": _llama("debug", v=512, h=128, i=384, l=2, q=4, kv=2, d=32, s=256),
+    "bench-1b": _llama("bench-1b", h=2048, i=5632, l=22, q=16, kv=16, d=128, s=2048),
+    "bench-410m": _llama("bench-410m", h=1024, i=2816, l=24, q=16, kv=16, d=64, s=2048),
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown model config {name!r}; known: {sorted(CONFIGS)}")
+    cfg = CONFIGS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg

@@ -1,0 +1,413 @@
+"""Decoder-only transformer: functional JAX, one definition for every family.
+
+Design (TPU-first, not a port — the reference contains no model code and
+delegates compute to external containers, SURVEY.md §2a):
+
+- Params are a plain pytree: {"embed": …, "layers": {…stacked [L, …] arrays…},
+  "final_norm": …, "head": …}. Layers are *stacked* and the forward pass scans
+  over them with ``lax.scan`` — one compiled block instead of L unrolled ones
+  (faster compiles, natural remat boundary, later the unit of pipeline
+  parallelism).
+- Every major activation gets a logical sharding constraint
+  (runbooks_tpu.parallel.sharding) so pjit can propagate DP/FSDP/SP/TP layouts
+  from a rule table.
+- fp32 softmax/norms/logits; bf16 everything else by default.
+- One code path serves training (no cache) and inference (KVCache dataclass),
+  including chunked prefill: attention masking is by *absolute position*, so
+  sequence-parallel shards and cache decode use the same op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from runbooks_tpu.models.config import ModelConfig
+from runbooks_tpu.ops.attention import (
+    alibi_slopes,
+    dot_product_attention,
+    make_attention_mask,
+)
+from runbooks_tpu.ops.norms import layer_norm, rms_norm
+from runbooks_tpu.ops.rotary import apply_rope
+from runbooks_tpu.parallel.sharding import with_logical_constraint
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+def _dense_init(rng, shape, dtype, in_axis_size):
+    scale = in_axis_size ** -0.5
+    return (jax.random.normal(rng, shape) * scale).astype(dtype)
+
+
+def _norm_params(cfg: ModelConfig, shape_prefix=()):
+    h = cfg.hidden_size
+    pd = cfg.parameter_dtype
+    if cfg.norm_type == "rmsnorm":
+        return {"scale": jnp.ones(shape_prefix + (h,), pd)}
+    return {"scale": jnp.ones(shape_prefix + (h,), pd),
+            "bias": jnp.zeros(shape_prefix + (h,), pd)}
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
+    """Random-init parameters (stacked layers). For real checkpoints use
+    runbooks_tpu.models.convert (HF weight import)."""
+    h, v, L = cfg.hidden_size, cfg.vocab_size, cfg.num_layers
+    pd = cfg.parameter_dtype
+    keys = iter(jax.random.split(rng, 16))
+
+    params: Params = {
+        "embed": (jax.random.normal(next(keys), (v, h)) * h ** -0.5).astype(pd),
+        "final_norm": _norm_params(cfg),
+    }
+    if cfg.position_type == "learned":
+        params["pos_embed"] = (
+            jax.random.normal(next(keys), (cfg.max_seq_len, h)) * 0.02
+        ).astype(pd)
+    if not cfg.tie_embeddings:
+        params["head"] = _dense_init(next(keys), (h, v), pd, h)
+
+    layers: Params = {
+        "attn": {
+            "wq": _dense_init(next(keys), (L, h, cfg.q_dim), pd, h),
+            "wk": _dense_init(next(keys), (L, h, cfg.kv_dim), pd, h),
+            "wv": _dense_init(next(keys), (L, h, cfg.kv_dim), pd, h),
+            "wo": _dense_init(next(keys), (L, cfg.q_dim, h), pd, cfg.q_dim),
+        },
+        "ln1": _norm_params(cfg, (L,)),
+    }
+    if cfg.attn_bias:
+        layers["attn"]["bq"] = jnp.zeros((L, cfg.q_dim), pd)
+        layers["attn"]["bk"] = jnp.zeros((L, cfg.kv_dim), pd)
+        layers["attn"]["bv"] = jnp.zeros((L, cfg.kv_dim), pd)
+        layers["attn"]["bo"] = jnp.zeros((L, h), pd)
+    if cfg.qk_norm:
+        layers["attn"]["q_norm"] = jnp.ones((L, cfg.head_dim), pd)
+        layers["attn"]["k_norm"] = jnp.ones((L, cfg.head_dim), pd)
+
+    mlp: Params = {
+        "wo": _dense_init(next(keys), (L, cfg.intermediate_size, h), pd,
+                          cfg.intermediate_size),
+    }
+    if cfg.gated_mlp:
+        mlp["wi_gate"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
+        mlp["wi_up"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
+    else:
+        mlp["wi"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
+    if cfg.mlp_bias:
+        for k in ("wi_gate", "wi_up", "wi"):
+            if k in mlp:
+                mlp["b" + k[1:]] = jnp.zeros((L, cfg.intermediate_size), pd)
+        mlp["bo"] = jnp.zeros((L, h), pd)
+    layers["mlp"] = mlp
+
+    if not (cfg.parallel_block and cfg.shared_layer_norm):
+        layers["ln2"] = _norm_params(cfg, (L,))
+
+    params["layers"] = layers
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> Params:
+    """Pytree matching init_params, with logical axis names per dimension."""
+    norm1 = lambda pre: {k: pre + ("norm",) for k in
+                         (("scale", "bias") if cfg.norm_type == "layernorm"
+                          else ("scale",))}
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "final_norm": norm1(()),
+    }
+    if cfg.position_type == "learned":
+        axes["pos_embed"] = ("pos", "embed")
+    if not cfg.tie_embeddings:
+        axes["head"] = ("embed", "vocab")
+
+    attn = {
+        "wq": (None, "embed", "heads"),
+        "wk": (None, "embed", "kv_heads"),
+        "wv": (None, "embed", "kv_heads"),
+        "wo": (None, "heads", "embed"),
+    }
+    if cfg.attn_bias:
+        attn.update({"bq": (None, "heads"), "bk": (None, "kv_heads"),
+                     "bv": (None, "kv_heads"), "bo": (None, "norm")})
+    if cfg.qk_norm:
+        attn.update({"q_norm": (None, "head_dim"), "k_norm": (None, "head_dim")})
+
+    mlp = {"wo": (None, "mlp", "embed")}
+    if cfg.gated_mlp:
+        mlp.update({"wi_gate": (None, "embed", "mlp"),
+                    "wi_up": (None, "embed", "mlp")})
+    else:
+        mlp["wi"] = (None, "embed", "mlp")
+    if cfg.mlp_bias:
+        for k in list(mlp):
+            if k.startswith("wi"):
+                mlp["b" + k[1:]] = (None, "mlp")
+        mlp["bo"] = (None, "norm")
+
+    layers = {"attn": attn, "mlp": mlp, "ln1": norm1((None,))}
+    if not (cfg.parallel_block and cfg.shared_layer_norm):
+        layers["ln2"] = norm1((None,))
+    axes["layers"] = layers
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Per-model KV cache, layers stacked on the leading axis.
+
+    k, v: [num_layers, batch, max_len, num_kv_heads, head_dim]
+    index: [] int32 — number of tokens already written (same for the batch;
+    per-sequence lengths are handled by the serving engine's position logic).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    index: jax.Array
+
+    @classmethod
+    def create(cls, cfg: ModelConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, cfg.activation_dtype),
+            v=jnp.zeros(shape, cfg.activation_dtype),
+            index=jnp.zeros((), jnp.int32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+def _norm(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
+    if cfg.norm_type == "rmsnorm":
+        return rms_norm(x, p["scale"], cfg.norm_eps)
+    return layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
+
+
+def _activation(cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    if cfg.activation == "silu":
+        return jax.nn.silu(x)
+    if cfg.activation == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.relu(x)
+
+
+def _attention_block(
+    cfg: ModelConfig,
+    p: Params,
+    x: jax.Array,                      # [b, s, h] activation dtype
+    positions: jax.Array,              # [b, s]
+    mask: Optional[jax.Array],
+    bias: Optional[jax.Array],
+    layer_cache: Optional[Tuple[jax.Array, jax.Array, jax.Array]],
+):
+    b, s, _ = x.shape
+    ad = cfg.activation_dtype
+
+    def proj(w, bname):
+        y = jnp.einsum("bsh,hd->bsd", x, w.astype(ad),
+                       preferred_element_type=jnp.float32).astype(ad)
+        if bname in p:
+            y = y + p[bname].astype(ad)
+        return y
+
+    q = proj(p["wq"], "bq").reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = proj(p["wk"], "bk").reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = proj(p["wv"], "bv").reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = with_logical_constraint(q, ("batch", "seq", "act_heads", None))
+    k = with_logical_constraint(k, ("batch", "seq", "act_heads", None))
+    v = with_logical_constraint(v, ("batch", "seq", "act_heads", None))
+
+    if cfg.qk_norm:
+        q = rms_norm(q, p["q_norm"], cfg.norm_eps)
+        k = rms_norm(k, p["k_norm"], cfg.norm_eps)
+    if cfg.position_type == "rope":
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+
+    new_layer_cache = None
+    if layer_cache is not None:
+        ck, cv, index = layer_cache
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, index, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, index, 0, 0))
+        k, v = ck, cv
+        new_layer_cache = (ck, cv)
+
+    out = dot_product_attention(
+        q, k, v, mask=mask, bias=bias,
+        logit_softcap=cfg.logit_softcap,
+    )
+    out = out.reshape(b, s, cfg.q_dim)
+    out = jnp.einsum("bsd,dh->bsh", out, p["wo"].astype(ad),
+                     preferred_element_type=jnp.float32).astype(ad)
+    if "bo" in p:
+        out = out + p["bo"].astype(ad)
+    return out, new_layer_cache
+
+
+def _mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
+    ad = cfg.activation_dtype
+
+    def mm(y, w):
+        return jnp.einsum("bsh,hd->bsd", y, w.astype(ad),
+                          preferred_element_type=jnp.float32).astype(ad)
+
+    if cfg.gated_mlp:
+        gate = mm(x, p["wi_gate"])
+        up = mm(x, p["wi_up"])
+        if "bi_gate" in p:
+            gate = gate + p["bi_gate"].astype(ad)
+            up = up + p["bi_up"].astype(ad)
+        hidden = _activation(cfg, gate) * up
+    else:
+        hidden = mm(x, p["wi"])
+        if "bi" in p:
+            hidden = hidden + p["bi"].astype(ad)
+        hidden = _activation(cfg, hidden)
+    hidden = with_logical_constraint(hidden, ("batch", "seq", "act_mlp"))
+    out = mm(hidden, p["wo"])
+    if "bo" in p:
+        out = out + p["bo"].astype(ad)
+    return out
+
+
+def _block(cfg: ModelConfig, layer: Params, x, positions, mask, bias,
+           layer_cache):
+    """One transformer block. x: [b, s, h]."""
+    x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+    h1 = _norm(cfg, layer["ln1"], x)
+    attn_out, new_cache = _attention_block(
+        cfg, layer["attn"], h1, positions, mask, bias, layer_cache)
+    if cfg.parallel_block:
+        h2 = h1 if cfg.shared_layer_norm else _norm(cfg, layer["ln2"], x)
+        mlp_out = _mlp_block(cfg, layer["mlp"], h2)
+        x = x + attn_out + mlp_out
+    else:
+        x = x + attn_out
+        h2 = _norm(cfg, layer["ln2"], x)
+        x = x + _mlp_block(cfg, layer["mlp"], h2)
+    x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+    return x, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,                      # [b, s] int32
+    *,
+    positions: Optional[jax.Array] = None,  # [b, s] absolute positions
+    segment_ids: Optional[jax.Array] = None,  # [b, s] packed-seq ids (0 = pad)
+    cache: Optional[KVCache] = None,
+    remat: bool = False,
+) -> Tuple[jax.Array, Optional[KVCache]]:
+    """Returns (logits [b, s, vocab] float32, updated cache or None).
+
+    Without cache: standard training/eval forward, causal + segment masking.
+    With cache: tokens are appended at cache.index (prefill chunks or single-
+    token decode); positions default to index + arange(s).
+    """
+    b, s = tokens.shape
+    ad = cfg.activation_dtype
+
+    if cache is not None and segment_ids is not None:
+        raise NotImplementedError(
+            "packed sequences (segment_ids) are not supported together with a "
+            "KV cache: the cache mask is positional-only. Prefill packed "
+            "batches without a cache, or one sequence per batch row with one."
+        )
+
+    if positions is None:
+        if cache is not None:
+            positions = cache.index + jnp.arange(s, dtype=jnp.int32)[None, :]
+            positions = jnp.broadcast_to(positions, (b, s))
+        else:
+            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
+                                         (b, s))
+
+    x = params["embed"].astype(ad)[tokens]
+    if cfg.embed_scale:
+        x = x * (cfg.hidden_size ** 0.5)
+    if cfg.position_type == "learned":
+        x = x + params["pos_embed"].astype(ad)[positions]
+    x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+    # Mask & bias over the full kv extent.
+    if cache is not None:
+        max_kv = cache.k.shape[2]
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(max_kv, dtype=jnp.int32)[None, :], (b, max_kv))
+        # Slots at arange > q position are either future or unwritten: the
+        # causal comparison masks both, so no separate validity mask needed.
+        mask = make_attention_mask(positions, kv_positions, causal=True)
+    else:
+        kv_positions = positions
+        mask = make_attention_mask(
+            positions, kv_positions, segment_ids, segment_ids, causal=True)
+
+    bias = None
+    if cfg.position_type == "alibi":
+        slopes = alibi_slopes(cfg.num_heads)  # [h]
+        rel = (kv_positions[:, None, :] - positions[:, :, None]).astype(jnp.float32)
+        bias = slopes[None, :, None, None] * rel[:, None, :, :]
+
+    block = _block
+    if remat:
+        block = jax.checkpoint(
+            _block, policy=_remat_policy(cfg.remat_policy),
+            static_argnums=(0,))
+
+    def scan_body(carry, scanned):
+        x = carry
+        if cache is not None:
+            layer, ck, cv = scanned
+            layer_cache = (ck, cv, cache.index)
+        else:
+            layer = scanned
+            layer_cache = None
+        x, new_cache = block(cfg, layer, x, positions, mask, bias, layer_cache)
+        return x, new_cache
+
+    if cache is not None:
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_body, x, (params["layers"], cache.k, cache.v))
+        new_cache = KVCache(k=new_k, v=new_v, index=cache.index + s)
+    else:
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        new_cache = None
+
+    x = _norm(cfg, params["final_norm"], x)
+    head = params["embed"].T if cfg.tie_embeddings else params["head"]
+    logits = jnp.einsum("bsh,hv->bsv", x.astype(jnp.float32),
+                        head.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    logits = with_logical_constraint(logits, ("batch", "seq", None))
+    return logits, new_cache
+
+
+def _remat_policy(name: str):
+    policies = {
+        "none": None,
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "dots_with_no_batch_dims_saveable":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    return policies.get(name, jax.checkpoint_policies.nothing_saveable)

@@ -1,0 +1,114 @@
+"""Multi-head attention with GQA/MQA, packed-sequence masking, and ALiBi.
+
+Two execution paths:
+  - ``dot_product_attention``: reference XLA einsum path. fp32 softmax. XLA
+    fuses this well on TPU for moderate sequence lengths and it is the
+    numerically-trusted oracle for kernel tests.
+  - ``runbooks_tpu.ops.flash_attention``: Pallas blockwise kernel for long
+    sequences (imported lazily by ``attention`` to keep CPU tests light).
+
+Masking model: a query token q may attend to key token k iff
+  positions[k] <= positions[q]   (causal, by absolute position — this makes
+                                  the op correct under sequence-parallel
+                                  sharding and KV-cache decode)
+  and segment_ids match          (packed-sequence isolation)
+  and k is not padding (segment_id != 0 when segment_ids given).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def make_attention_mask(
+    q_positions: jax.Array,        # [b, q_len] int32 absolute positions
+    kv_positions: jax.Array,       # [b, kv_len]
+    q_segment_ids: Optional[jax.Array] = None,   # [b, q_len]
+    kv_segment_ids: Optional[jax.Array] = None,  # [b, kv_len]
+    causal: bool = True,
+) -> jax.Array:
+    """Boolean mask [b, 1, q_len, kv_len]; True = may attend."""
+    mask = jnp.ones(
+        (q_positions.shape[0], q_positions.shape[1], kv_positions.shape[1]),
+        dtype=bool,
+    )
+    if causal:
+        mask &= kv_positions[:, None, :] <= q_positions[:, :, None]
+    if q_segment_ids is not None and kv_segment_ids is not None:
+        mask &= q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]
+        mask &= kv_segment_ids[:, None, :] != 0
+    return mask[:, None, :, :]
+
+
+def alibi_slopes(num_heads: int) -> jax.Array:
+    """ALiBi per-head slopes (geometric sequence), [num_heads] float32."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        vals = pow2_slopes(num_heads)
+    else:
+        closest = 2 ** math.floor(math.log2(num_heads))
+        vals = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)[0::2]
+        vals += extra[: num_heads - closest]
+    return jnp.asarray(vals, dtype=jnp.float32)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[b, s, kv_heads, d] -> [b, s, kv_heads*n_rep, d] for GQA broadcast."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def dot_product_attention(
+    q: jax.Array,                   # [b, q_len, num_heads, head_dim]
+    k: jax.Array,                   # [b, kv_len, num_kv_heads, head_dim]
+    v: jax.Array,                   # [b, kv_len, num_kv_heads, head_dim]
+    mask: Optional[jax.Array] = None,       # [b, 1|h, q_len, kv_len] bool
+    bias: Optional[jax.Array] = None,       # [b|1, h, q_len, kv_len] additive
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Reference attention. fp32 logits/softmax, output in q.dtype."""
+    *_, num_heads, head_dim = q.shape
+    num_kv_heads = k.shape[-2]
+    scale = scale if scale is not None else head_dim ** -0.5
+
+    k = repeat_kv(k, num_heads // num_kv_heads)
+    v = repeat_kv(v, num_heads // num_kv_heads)
+
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    if logit_softcap is not None:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    # Fully-masked query rows (e.g. padding) softmax to uniform; zero them so
+    # padding contributes nothing downstream.
+    if mask is not None:
+        any_valid = jnp.any(mask, axis=-1, keepdims=True)
+        probs = jnp.where(any_valid, probs, 0.0)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

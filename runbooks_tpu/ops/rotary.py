@@ -1,0 +1,34 @@
+"""Rotary position embeddings (RoPE).
+
+Split-halves convention (as used by Llama/NeoX): the head dim is split into
+two halves which are rotated as (real, imag) pairs. Computed in float32 and
+cast back; sin/cos are generated on the fly from integer positions so the op
+is position-shift-friendly for KV-cache decoding and sequence-parallel shards
+(each shard passes its own absolute positions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_sin_cos(positions: jax.Array, head_dim: int, theta: float = 10000.0):
+    """positions [...,] int32 -> (sin, cos) each [..., head_dim//2] float32."""
+    half = head_dim // 2
+    freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freq  # [..., half]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:
+    """Apply RoPE. x: [batch, seq, heads, head_dim]; positions: [batch, seq]."""
+    dtype = x.dtype
+    half = x.shape[-1] // 2
+    sin, cos = rope_sin_cos(positions, x.shape[-1], theta)  # [b, s, half]
+    sin = sin[:, :, None, :]  # broadcast over heads
+    cos = cos[:, :, None, :]
+    x = x.astype(jnp.float32)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)

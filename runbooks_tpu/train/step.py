@@ -1,0 +1,166 @@
+"""Sharded train state + train step.
+
+The whole step (fwd, bwd, optimizer) is one jit'ed function over the mesh;
+XLA inserts all collectives (FSDP all-gathers, TP all-reduces, gradient
+reduce-scatters) from the sharding annotations — there is no hand-written
+communication here (SURVEY.md §2a: the reference has no distributed backend;
+this is the TPU-native equivalent, XLA collectives over ICI/DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from runbooks_tpu.models.config import ModelConfig
+from runbooks_tpu.models.transformer import forward, init_params, param_logical_axes
+from runbooks_tpu.parallel.sharding import spec_for_array
+
+Params = Any
+Batch = Dict[str, jax.Array]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: Params
+    opt_state: Any
+
+
+def cross_entropy_loss(
+    logits: jax.Array,        # [b, s, v] float32
+    targets: jax.Array,       # [b, s] int32
+    weights: Optional[jax.Array] = None,  # [b, s] float {0,1} loss mask
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (mean loss over weighted tokens, total weight)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if weights is None:
+        weights = jnp.ones_like(nll)
+    weights = weights.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.sum(nll * weights) / total, total
+
+
+def infer_state_shardings(cfg: ModelConfig, state_shapes: TrainState,
+                          mesh: Mesh, rules=None) -> TrainState:
+    """Shardings for a full TrainState.
+
+    Optimizer moments (adam mu/nu) have the same tree *suffix* paths as the
+    params they track, so each state leaf is matched to a param's logical axes
+    by its longest dict-key suffix; unmatched leaves (counts, scalars)
+    replicate.
+    """
+    axes = param_logical_axes(cfg)
+    flat_axes: Dict[Tuple[str, ...], tuple] = {}
+    def record(path, leaf):
+        keys = tuple(k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey))
+        flat_axes[keys] = leaf
+        return leaf
+    jax.tree_util.tree_map_with_path(
+        record, axes,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x))
+
+    def assign(path, leaf):
+        keys = tuple(k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey))
+        for i in range(len(keys) + 1):
+            logical = flat_axes.get(keys[i:])
+            if logical is not None and len(logical) <= len(leaf.shape):
+                return NamedSharding(
+                    mesh, spec_for_array(leaf.shape, logical, mesh, rules))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(assign, state_shapes)
+
+
+def batch_shardings(mesh: Mesh, batch_shapes, rules=None) -> Any:
+    def one(s):
+        logical = ("batch", "seq") if len(s.shape) == 2 else ("batch",)
+        return NamedSharding(mesh, spec_for_array(s.shape, logical, mesh, rules))
+    return jax.tree.map(one, batch_shapes)
+
+
+def create_train_state(
+    cfg: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: jax.Array,
+    rules=None,
+) -> Tuple[TrainState, TrainState]:
+    """Initialize a sharded TrainState directly on the mesh.
+
+    Returns (state, state_shardings). Init happens inside jit with
+    out_shardings so large models materialize already sharded (no single-host
+    OOM).
+    """
+
+    def init_fn(rng):
+        params = init_params(cfg, rng)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+        )
+
+    state_shapes = jax.eval_shape(init_fn, rng)
+    shardings = infer_state_shardings(cfg, state_shapes, mesh, rules)
+    with jax.set_mesh(mesh):
+        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    state_shardings: TrainState,
+    rules=None,
+    remat: bool = True,
+):
+    """Build the jit'ed train step: (state, batch) -> (state, metrics).
+
+    Batch keys: tokens [b,s], targets [b,s], and optional loss_mask [b,s],
+    segment_ids [b,s], positions [b,s].
+    """
+
+    def step_fn(state: TrainState, batch: Batch):
+        def loss_fn(params):
+            logits, _ = forward(
+                cfg, params, batch["tokens"],
+                positions=batch.get("positions"),
+                segment_ids=batch.get("segment_ids"),
+                remat=remat,
+            )
+            loss, total = cross_entropy_loss(
+                logits, batch["targets"], batch.get("loss_mask"))
+            return loss, total
+
+        (loss, total_weight), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "weight_tokens": total_weight,
+        }
+        return TrainState(step=state.step + 1, params=new_params,
+                          opt_state=new_opt_state), metrics
+
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, None),
+        out_shardings=(state_shardings, replicated),
+        donate_argnums=(0,),
+    )

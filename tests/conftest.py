@@ -1,0 +1,34 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX initializes.
+
+Multi-chip TPU hardware is not available in CI; all sharding logic is tested on
+a virtual 8-device CPU mesh (the same technique the driver's dryrun_multichip
+uses). Mirrors the reference's strategy of testing the whole operator loop
+without cloud dependencies (SURVEY.md §4: envtest + kind cloud).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Exact-math tests: JAX's *default* matmul precision may round inputs to
+# bf16 even for f32 arrays, which makes results shape-dependent (full matmul
+# vs sliced matmul accumulate differently). Pin highest precision in tests;
+# production code on TPU keeps the fast default (bf16 on the MXU).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

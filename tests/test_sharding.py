@@ -1,0 +1,49 @@
+"""Sharding-rule unit tests, incl. the regression for constraints under
+jax.set_mesh (they must bind to the context mesh, not silently no-op)."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+from runbooks_tpu.parallel.sharding import (
+    logical_to_spec,
+    spec_for_array,
+    with_logical_constraint,
+)
+
+
+def test_logical_to_spec_dedups_mesh_axes():
+    # "batch" uses (data, fsdp); a second logical axis mapping to fsdp must
+    # not reuse it within one spec.
+    spec = logical_to_spec(("batch", "embed"))
+    assert spec == P(("data", "fsdp"), None)
+
+
+def test_spec_for_array_drops_nondivisible_axes():
+    mesh = make_mesh(MeshConfig(data=1, fsdp=8, sequence=1, tensor=1))
+    # dim 4 not divisible by fsdp=8 -> replicated
+    assert spec_for_array((4, 16), ("embed", None), mesh) == P(None, None)
+    assert spec_for_array((16, 4), ("embed", None), mesh) == P("fsdp", None)
+
+
+def test_constraint_applies_under_set_mesh():
+    mesh = make_mesh(MeshConfig(data=2, fsdp=4, sequence=1, tensor=1))
+
+    @jax.jit
+    def f(x):
+        return with_logical_constraint(x, ("batch", "seq"))
+
+    with jax.set_mesh(mesh):
+        y = f(jnp.zeros((8, 16)))
+    # Regression: under set_mesh this used to silently return the input
+    # unconstrained (thread_resources is not populated by set_mesh).
+    assert y.sharding.spec[0] == ("data", "fsdp"), y.sharding.spec
+    shard_shapes = {s.data.shape for s in y.addressable_shards}
+    assert shard_shapes == {(1, 16)}, shard_shapes
+
+
+def test_constraint_noop_outside_mesh():
+    x = jnp.zeros((8, 16))
+    y = with_logical_constraint(x, ("batch", None))
+    assert y.shape == x.shape

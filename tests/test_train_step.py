@@ -1,0 +1,88 @@
+"""Sharded train-step tests on the 8-device virtual CPU mesh.
+
+Validates the full DP/FSDP/TP/SP layouts compile and execute, that loss
+decreases on an overfit batch, and that different mesh layouts produce the
+same numerics (the sharding must not change the math).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from runbooks_tpu.models.config import get_config
+from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
+from runbooks_tpu.train.step import create_train_state, make_train_step
+
+
+def tiny_cfg():
+    return dataclasses.replace(
+        get_config("llama2-7b"), vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=32, dtype="float32",
+    )
+
+
+def make_batch(cfg, batch=8, seq=16, seed=0):
+    rng = jax.random.key(seed)
+    tokens = jax.random.randint(rng, (batch, seq + 1), 0, cfg.vocab_size)
+    return {
+        "tokens": tokens[:, :-1],
+        "targets": tokens[:, 1:],
+        "loss_mask": jnp.ones((batch, seq), jnp.float32),
+    }
+
+
+def run_steps(mesh_config, n_steps=3, seed=0):
+    cfg = tiny_cfg()
+    mesh = make_mesh(mesh_config)
+    opt = make_optimizer(OptimizerConfig(learning_rate=1e-2, warmup_steps=0,
+                                         total_steps=100, schedule="constant"))
+    state, shardings = create_train_state(cfg, opt, mesh, jax.random.key(seed))
+    step = make_train_step(cfg, opt, mesh, shardings)
+    batch = make_batch(cfg)
+    losses = []
+    with jax.set_mesh(mesh):
+        for _ in range(n_steps):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    return losses, state
+
+
+MESHES = [
+    MeshConfig(data=8, fsdp=1, sequence=1, tensor=1),
+    MeshConfig(data=1, fsdp=8, sequence=1, tensor=1),
+    MeshConfig(data=1, fsdp=1, sequence=1, tensor=8),
+    MeshConfig(data=2, fsdp=2, sequence=1, tensor=2),
+    MeshConfig(data=1, fsdp=2, sequence=2, tensor=2),
+]
+
+
+@pytest.mark.parametrize("mesh_config", MESHES, ids=lambda m: f"d{m.data}f{m.fsdp}s{m.sequence}t{m.tensor}")
+def test_train_step_runs_and_learns(mesh_config):
+    losses, _ = run_steps(mesh_config, n_steps=4)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+def test_mesh_layouts_agree_numerically():
+    ref_losses, _ = run_steps(MeshConfig(data=8, fsdp=1, sequence=1, tensor=1))
+    for mc in [MeshConfig(data=1, fsdp=8, sequence=1, tensor=1),
+               MeshConfig(data=2, fsdp=2, sequence=1, tensor=2)]:
+        losses, _ = run_steps(mc)
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+
+
+def test_fsdp_actually_shards_params():
+    cfg = tiny_cfg()
+    mesh = make_mesh(MeshConfig(data=1, fsdp=8, sequence=1, tensor=1))
+    opt = make_optimizer(OptimizerConfig())
+    state, _ = create_train_state(cfg, opt, mesh, jax.random.key(0))
+    # embed is [vocab=128, embed=64]: fsdp shards the embed axis of layer
+    # matrices; check a layer matrix is actually distributed.
+    wq = state.params["layers"]["attn"]["wq"]  # [L, h=64, q_dim=64]
+    shard_shapes = {s.data.shape for s in wq.addressable_shards}
+    assert shard_shapes == {(2, 8, 64)}, shard_shapes

@@ -1,0 +1,134 @@
+"""Model forward-pass correctness tests.
+
+Mirrors the reference's test philosophy (SURVEY.md §4: hermetic, no cloud/
+hardware deps) — everything runs on the 8-device virtual CPU platform from
+conftest.py.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from runbooks_tpu.models.config import get_config
+from runbooks_tpu.models.transformer import KVCache, forward, init_params
+
+
+def tiny(family: str):
+    base = get_config(family)
+    return dataclasses.replace(
+        base, vocab_size=256, hidden_size=64,
+        intermediate_size=128 if not base.gated_mlp else 96,
+        num_layers=2, num_heads=4,
+        num_kv_heads=2 if base.num_kv_heads < base.num_heads else 4,
+        head_dim=16, max_seq_len=64,
+        dtype="float32",  # exact-math tests; bf16 noise tested separately
+    )
+
+
+FAMILIES = ["llama2-7b", "falcon-7b", "opt-125m"]
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_forward_shapes_finite(family):
+    cfg = tiny(family)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    logits, cache = forward(cfg, params, tokens)
+    assert cache is None
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_causality(family):
+    cfg = tiny(family)
+    params = init_params(cfg, jax.random.key(0))
+    t1 = jax.random.randint(jax.random.key(1), (1, 12), 0, cfg.vocab_size)
+    t2 = t1.at[0, -1].set((t1[0, -1] + 1) % cfg.vocab_size)
+    l1, _ = forward(cfg, params, t1)
+    l2, _ = forward(cfg, params, t2)
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], rtol=2e-4, atol=2e-4)
+    assert not np.allclose(l1[0, -1], l2[0, -1])
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_kv_cache_matches_full_forward(family):
+    cfg = tiny(family)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 10), 0, cfg.vocab_size)
+
+    full_logits, _ = forward(cfg, params, tokens)
+
+    # Chunked prefill (6 tokens) + token-by-token decode.
+    cache = KVCache.create(cfg, batch=2, max_len=16)
+    logits_pre, cache = forward(cfg, params, tokens[:, :6], cache=cache)
+    got = [logits_pre]
+    for i in range(6, 10):
+        step_logits, cache = forward(cfg, params, tokens[:, i:i + 1], cache=cache)
+        got.append(step_logits)
+    cached_logits = jnp.concatenate(got, axis=1)
+    np.testing.assert_allclose(full_logits, cached_logits, rtol=2e-5, atol=2e-5)
+    assert int(cache.index) == 10
+
+
+def test_packed_segments_are_isolated():
+    cfg = tiny("llama2-7b")
+    params = init_params(cfg, jax.random.key(0))
+    a = jax.random.randint(jax.random.key(1), (1, 5), 0, cfg.vocab_size)
+    b = jax.random.randint(jax.random.key(2), (1, 7), 0, cfg.vocab_size)
+
+    packed = jnp.concatenate([a, b], axis=1)
+    segs = jnp.asarray([[1] * 5 + [2] * 7], jnp.int32)
+    positions = jnp.asarray([list(range(5)) + list(range(7))], jnp.int32)
+    lp, _ = forward(cfg, params, packed, positions=positions, segment_ids=segs)
+
+    la, _ = forward(cfg, params, a)
+    lb, _ = forward(cfg, params, b)
+    np.testing.assert_allclose(lp[0, :5], la[0], rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(lp[0, 5:], lb[0], rtol=2e-5, atol=2e-5)
+
+
+def test_padding_segment_zero_is_masked():
+    cfg = tiny("llama2-7b")
+    params = init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab_size)
+    segs = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.int32)
+    l1, _ = forward(cfg, params, toks, segment_ids=segs)
+    # Changing padding tokens must not change real-token logits.
+    toks2 = toks.at[0, 5].set((toks[0, 5] + 3) % cfg.vocab_size)
+    l2, _ = forward(cfg, params, toks2, segment_ids=segs)
+    np.testing.assert_allclose(l1[0, :4], l2[0, :4], rtol=1e-5, atol=1e-5)
+
+
+def test_remat_matches_no_remat():
+    cfg = tiny("llama2-7b")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab_size)
+    l1, _ = forward(cfg, params, tokens)
+    l2, _ = forward(cfg, params, tokens, remat=True)
+    np.testing.assert_allclose(l1, l2, rtol=1e-6, atol=1e-6)
+
+
+def test_bf16_forward_close_to_fp32():
+    cfg32 = tiny("llama2-7b")
+    cfg16 = dataclasses.replace(cfg32, dtype="bfloat16")
+    params = init_params(cfg32, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg32.vocab_size)
+    l32, _ = forward(cfg32, params, tokens)
+    l16, _ = forward(cfg16, params, tokens)
+    # bf16 activations should track fp32 within a few percent on a tiny model.
+    assert float(jnp.max(jnp.abs(l32 - l16))) < 0.15
+
+
+def test_param_count_matches_config():
+    from runbooks_tpu.models.config import ModelConfig
+
+    for family in FAMILIES:
+        cfg = tiny(family)
+        params = init_params(cfg, jax.random.key(0))
+        n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert n == cfg.num_params, f"{family}: {n} != {cfg.num_params}"
