@@ -1,0 +1,64 @@
+"""Checkpoint/resume on the container contract's artifact layout.
+
+The reference delegates checkpointing entirely to user containers, providing
+only a durable bucket mounted RW at /content/artifacts (reference:
+internal/controller/model_controller.go:348-357, docs/design.md "bucket as
+source of truth"; SURVEY.md §5.4). Here it is first-class: orbax checkpoints
+under ``{artifacts}/checkpoints/{step}``, async by default (training continues
+while the previous step uploads), resume = restore latest.
+
+Sharding-aware: restore takes the target TrainState shardings, so a
+checkpoint written on one mesh layout restores onto another (orbax reshards).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin orbax wrapper bound to an artifact directory."""
+
+    def __init__(self, artifacts_dir: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        self.directory = os.path.join(os.path.abspath(artifacts_dir),
+                                      "checkpoints")
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure/shardings of ``state_like`` (a TrainState
+        of jax.ShapeDtypeStruct with .sharding set, or a concrete state)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        def as_abstract(x):
+            if isinstance(x, jax.Array):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            return x
+        abstract = jax.tree.map(as_abstract, state_like)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        """Block until in-flight async saves land (call before exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

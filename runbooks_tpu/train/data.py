@@ -1,0 +1,190 @@
+"""Input pipeline: tokenize -> pack -> batch, feeding the sharded train step.
+
+The reference's data story is "a Dataset job writes files to
+/content/artifacts, the trainer container reads /content/data" (reference:
+docs/container-contract.md; internal/controller/dataset_controller.go). This
+module is the trainer-side half: it reads jsonl/text files (as mounted at
+/content/data), tokenizes, and packs multiple documents per row with
+segment_ids/positions so the model's packed-sequence masking keeps documents
+isolated (no cross-contamination, no padding waste — the TPU-efficient way to
+fine-tune on variable-length data).
+
+Host-side is pure numpy (prefetch-friendly); device placement happens in the
+trainer with the mesh's batch shardings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+
+class ByteTokenizer:
+    """Dependency-free byte-level tokenizer (hermetic default: works with no
+    downloaded vocab). ids 0..255 = bytes, 256 = BOS, 257 = EOS."""
+
+    bos_id = 256
+    eos_id = 257
+    vocab_size = 258
+
+    def encode(self, text: str, add_bos: bool = True,
+               add_eos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def load_tokenizer(name_or_path: Optional[str] = None):
+    """HF tokenizer when available (local files only — zero-egress images),
+    else the byte tokenizer."""
+    if name_or_path:
+        try:
+            from transformers import AutoTokenizer
+
+            return AutoTokenizer.from_pretrained(
+                name_or_path, local_files_only=True)
+        except Exception:
+            pass
+    return ByteTokenizer()
+
+
+def read_documents(path: str, text_key: str = "text") -> Iterator[str]:
+    """Yield documents from a file or directory: .jsonl ({text_key: ...} per
+    line), .txt (one doc per file), or a directory of either."""
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            yield from read_documents(os.path.join(path, name), text_key)
+        return
+    if path.endswith((".jsonl", ".json")):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                text = obj.get(text_key)
+                if text:
+                    yield text
+    elif path.endswith(".txt"):
+        with open(path) as f:
+            yield f.read()
+
+
+def pack_documents(
+    token_docs: Iterable[Sequence[int]],
+    seq_len: int,
+    drop_remainder: bool = False,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Greedy-pack token documents into rows of seq_len+1 tokens.
+
+    Each yielded row dict has (all [seq_len]):
+      tokens, targets (next-token), segment_ids (1-based per doc, 0 = pad),
+      positions (restart per doc), loss_mask (0 on pad).
+    Documents longer than the row are split across rows (their continuation
+    keeps advancing positions so long docs still train full-context).
+    """
+    row_toks: List[int] = []
+    row_segs: List[int] = []
+    row_pos: List[int] = []
+    seg = 0
+
+    def flush():
+        nonlocal row_toks, row_segs, row_pos, seg
+        n = seq_len + 1
+        toks = row_toks[:n]
+        segs = row_segs[:n]
+        pos = row_pos[:n]
+        pad = n - len(toks)
+        if pad:
+            toks += [0] * pad
+            segs += [0] * pad
+            pos += [0] * pad
+        row = {
+            "tokens": np.asarray(toks[:-1], np.int32),
+            "targets": np.asarray(toks[1:], np.int32),
+            "segment_ids": np.asarray(segs[:-1], np.int32),
+            "positions": np.asarray(pos[:-1], np.int32),
+            # A target is trainable iff it belongs to the same (non-pad)
+            # segment as its input token (no loss across doc boundaries).
+            "loss_mask": np.asarray(
+                [1.0 if segs[i] != 0 and segs[i] == segs[i + 1] else 0.0
+                 for i in range(seq_len)], np.float32),
+        }
+        row_toks, row_segs, row_pos = row_toks[n:], row_segs[n:], row_pos[n:]
+        if row_toks:
+            # continuation of a split document: positions keep counting
+            seg += 1
+            row_segs = [seg] * len(row_toks)
+        return row
+
+    for doc in token_docs:
+        doc = list(doc)
+        if not doc:
+            continue
+        seg += 1
+        row_toks += doc
+        row_segs += [seg] * len(doc)
+        row_pos += list(range(len(doc)))
+        while len(row_toks) >= seq_len + 1:
+            yield flush()
+    if row_toks and not drop_remainder:
+        yield flush()
+
+
+def batch_rows(rows: Iterator[Dict[str, np.ndarray]],
+               batch_size: int,
+               drop_remainder: bool = True) -> Iterator[Batch]:
+    buf: List[Dict[str, np.ndarray]] = []
+    for row in rows:
+        buf.append(row)
+        if len(buf) == batch_size:
+            yield {k: np.stack([r[k] for r in buf]) for k in buf[0]}
+            buf = []
+    if buf and not drop_remainder:
+        while len(buf) < batch_size:  # pad with empty rows
+            buf.append({k: np.zeros_like(v) for k, v in buf[0].items()})
+        yield {k: np.stack([r[k] for r in buf]) for k in buf[0]}
+
+
+def dataset(
+    path: str,
+    seq_len: int,
+    batch_size: int,
+    tokenizer=None,
+    epochs: Optional[int] = 1,
+    text_key: str = "text",
+) -> Iterator[Batch]:
+    """End-to-end: files -> packed, batched numpy batches. epochs=None loops
+    forever."""
+    tokenizer = tokenizer or ByteTokenizer()
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        docs = (tokenizer.encode(t) for t in read_documents(path, text_key))
+        yield from batch_rows(pack_documents(docs, seq_len), batch_size)
+        epoch += 1
+
+
+def synthetic_batches(vocab_size: int, seq_len: int, batch_size: int,
+                      seed: int = 0) -> Iterator[Batch]:
+    """Random-token batches for benchmarks and smoke tests."""
+    rng = np.random.default_rng(seed)
+    while True:
+        toks = rng.integers(1, vocab_size, (batch_size, seq_len + 1),
+                            dtype=np.int32)
+        yield {
+            "tokens": toks[:, :-1],
+            "targets": toks[:, 1:],
+            "loss_mask": np.ones((batch_size, seq_len), np.float32),
+        }

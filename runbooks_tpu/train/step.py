@@ -48,16 +48,15 @@ def cross_entropy_loss(
     return jnp.sum(nll * weights) / total, total
 
 
-def infer_state_shardings(cfg: ModelConfig, state_shapes: TrainState,
+def infer_state_shardings(axes: Any, state_shapes: TrainState,
                           mesh: Mesh, rules=None) -> TrainState:
-    """Shardings for a full TrainState.
+    """Shardings for a full TrainState given the params' logical-axes tree.
 
     Optimizer moments (adam mu/nu) have the same tree *suffix* paths as the
     params they track, so each state leaf is matched to a param's logical axes
     by its longest dict-key suffix; unmatched leaves (counts, scalars)
     replicate.
     """
-    axes = param_logical_axes(cfg)
     flat_axes: Dict[Tuple[str, ...], tuple] = {}
     def record(path, leaf):
         keys = tuple(k.key for k in path
@@ -112,7 +111,8 @@ def create_train_state(
         )
 
     state_shapes = jax.eval_shape(init_fn, rng)
-    shardings = infer_state_shardings(cfg, state_shapes, mesh, rules)
+    shardings = infer_state_shardings(param_logical_axes(cfg), state_shapes,
+                                      mesh, rules)
     with jax.set_mesh(mesh):
         state = jax.jit(init_fn, out_shardings=shardings)(rng)
     return state, shardings

@@ -1,0 +1,212 @@
+"""The trainer workload: config -> mesh -> data -> sharded steps -> checkpoints.
+
+This is the TPU-native replacement for the external trainer containers the
+reference schedules (reference: examples/llama2-7b/finetuned-model.yaml uses
+substratusai/model-trainer-huggingface; here training is in-framework). It
+honors the container contract (/content/params.json in, /content/artifacts
+out) so the operator layer schedules it exactly like the reference schedules
+its trainer images.
+
+Entry point: ``python -m runbooks_tpu.train.trainer`` (reads params.json), or
+``run_training(TrainJobConfig(...))`` programmatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from runbooks_tpu.models.config import ModelConfig, get_config
+from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+from runbooks_tpu.train import data as data_mod
+from runbooks_tpu.train.checkpoint import CheckpointManager
+from runbooks_tpu.train.lora import (
+    LoraConfig,
+    create_lora_train_state,
+    make_lora_train_step,
+    merge as lora_merge,
+)
+from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
+from runbooks_tpu.train.step import create_train_state, make_train_step
+from runbooks_tpu.utils import contract
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainJobConfig:
+    model: str = "debug"
+    model_overrides: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    mesh: MeshConfig = MeshConfig()
+    optimizer: OptimizerConfig = OptimizerConfig()
+    lora: Optional[LoraConfig] = None
+
+    batch_size: int = 8
+    seq_len: int = 512
+    steps: int = 100
+    data_path: Optional[str] = None       # default: contract data dir
+    tokenizer: Optional[str] = None
+    seed: int = 0
+
+    checkpoint_every: int = 50
+    artifacts_dir: Optional[str] = None   # default: contract artifacts dir
+    log_every: int = 10
+    resume: bool = True
+
+    @classmethod
+    def from_params(cls, params: Dict[str, Any]) -> "TrainJobConfig":
+        """Build from a flat params.json dict (the operator-facing config
+        surface, like the reference's params -> PARAM_* convention)."""
+        kwargs: Dict[str, Any] = {}
+        simple = {f.name for f in dataclasses.fields(cls)
+                  if f.name not in ("mesh", "optimizer", "lora",
+                                    "model_overrides")}
+        for k, v in params.items():
+            if k in simple:
+                kwargs[k] = v
+        mesh_keys = {f.name for f in dataclasses.fields(MeshConfig)}
+        mesh_args = {k[len("mesh_"):]: int(v) for k, v in params.items()
+                     if k.startswith("mesh_") and k[len("mesh_"):] in mesh_keys}
+        if mesh_args:
+            kwargs["mesh"] = MeshConfig(**mesh_args)
+        opt_keys = {f.name for f in dataclasses.fields(OptimizerConfig)}
+        opt_args = {k: v for k, v in params.items() if k in opt_keys}
+        if opt_args:
+            kwargs["optimizer"] = OptimizerConfig(**opt_args)
+        if params.get("lora"):
+            lora = params["lora"]
+            kwargs["lora"] = (LoraConfig(**lora) if isinstance(lora, dict)
+                              else LoraConfig())
+        if params.get("model_overrides"):
+            kwargs["model_overrides"] = dict(params["model_overrides"])
+        return cls(**kwargs)
+
+
+def _batches(job: TrainJobConfig, model_cfg: ModelConfig) -> Iterator[dict]:
+    path = job.data_path or contract.data_dir()
+    import os
+
+    if path and os.path.exists(path):
+        tok = data_mod.load_tokenizer(job.tokenizer)
+        vocab = getattr(tok, "vocab_size", model_cfg.vocab_size)
+        assert vocab <= model_cfg.vocab_size, (
+            f"tokenizer vocab {vocab} exceeds model vocab "
+            f"{model_cfg.vocab_size}")
+        return data_mod.dataset(path, job.seq_len, job.batch_size,
+                                tokenizer=tok, epochs=None)
+    return data_mod.synthetic_batches(model_cfg.vocab_size, job.seq_len,
+                                      job.batch_size, job.seed)
+
+
+def run_training(job: TrainJobConfig,
+                 base_params=None) -> Dict[str, Any]:
+    """Run the training job; returns final metrics summary (also written to
+    {artifacts}/metrics.json)."""
+    import os
+
+    model_cfg = get_config(job.model, **job.model_overrides)
+    mesh = make_mesh(job.mesh)
+    optimizer = make_optimizer(job.optimizer)
+    artifacts = job.artifacts_dir or contract.artifacts_dir()
+    os.makedirs(artifacts, exist_ok=True)
+    ckpt = CheckpointManager(artifacts)
+
+    rng = jax.random.key(job.seed)
+    lora_mode = job.lora is not None
+    if lora_mode:
+        if base_params is None:
+            from runbooks_tpu.models.transformer import init_params
+            from runbooks_tpu.models.transformer import param_logical_axes
+            from runbooks_tpu.parallel.sharding import tree_shardings
+
+            shapes = jax.eval_shape(
+                lambda r: init_params(model_cfg, r), rng)
+            base_shardings = tree_shardings(
+                shapes, param_logical_axes(model_cfg), mesh)
+            with jax.set_mesh(mesh):
+                base_params = jax.jit(
+                    lambda r: init_params(model_cfg, r),
+                    out_shardings=base_shardings)(rng)
+        else:
+            from runbooks_tpu.models.transformer import param_logical_axes
+            from runbooks_tpu.parallel.sharding import tree_shardings
+
+            base_shardings = tree_shardings(
+                jax.eval_shape(lambda: base_params),
+                param_logical_axes(model_cfg), mesh)
+            base_params = jax.device_put(base_params, base_shardings)
+        state, shardings = create_lora_train_state(
+            model_cfg, job.lora, base_params, optimizer, mesh, rng)
+        step_fn = make_lora_train_step(
+            model_cfg, job.lora, optimizer, mesh, shardings, base_shardings)
+    else:
+        state, shardings = create_train_state(model_cfg, optimizer, mesh, rng)
+        step_fn = make_train_step(model_cfg, optimizer, mesh, shardings)
+
+    start_step = 0
+    if job.resume and ckpt.latest_step() is not None:
+        state = ckpt.restore(state)
+        start_step = int(state.step)
+
+    batches = _batches(job, model_cfg)
+    history = []
+    tokens_per_step = job.batch_size * job.seq_len
+    flops_per_token = 3.0 * model_cfg.flops_per_token(job.seq_len)
+    t_start = time.perf_counter()
+    tokens_done = 0
+
+    with jax.set_mesh(mesh):
+        for i in range(start_step, job.steps):
+            batch = {k: np.asarray(v) for k, v in next(batches).items()}
+            if lora_mode:
+                state, metrics = step_fn(state, base_params, batch)
+            else:
+                state, metrics = step_fn(state, batch)
+            tokens_done += tokens_per_step
+            if (i + 1) % job.log_every == 0 or i + 1 == job.steps:
+                loss = float(metrics["loss"])
+                dt = time.perf_counter() - t_start
+                tps = tokens_done / dt
+                entry = {"step": i + 1, "loss": round(loss, 4),
+                         "tokens_per_sec": round(tps, 1),
+                         "tflops_per_sec": round(tps * flops_per_token / 1e12,
+                                                 2)}
+                history.append(entry)
+                print(json.dumps(entry), flush=True)
+            if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:
+                ckpt.save(i + 1, state)
+
+    ckpt.wait()
+    summary = {
+        "final_loss": history[-1]["loss"] if history else None,
+        "steps": job.steps,
+        "tokens_per_sec": history[-1]["tokens_per_sec"] if history else None,
+        "model": job.model,
+        "lora": lora_mode,
+        "history": history,
+    }
+    with open(os.path.join(artifacts, "metrics.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    if lora_mode:
+        # Export merged params reference for serving (artifact contract).
+        merged_note = {"note": "merged weights = base + lora; see checkpoints"}
+        with open(os.path.join(artifacts, "lora.json"), "w") as f:
+            json.dump(dataclasses.asdict(job.lora) | merged_note, f)
+    ckpt.close()
+    return summary
+
+
+def main() -> int:
+    params = contract.load_params()
+    job = TrainJobConfig.from_params(params)
+    summary = run_training(job)
+    print(json.dumps({"done": True, **{k: v for k, v in summary.items()
+                                       if k != "history"}}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

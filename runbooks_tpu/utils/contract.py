@@ -1,0 +1,70 @@
+"""Container contract: the filesystem/env interface between the operator and
+workload containers.
+
+Mirrors the reference's contract (reference: docs/container-contract.md):
+  /content/params.json   — run parameters (mounted from a ConfigMap)
+  /content/data          — dataset mount (RO)
+  /content/model         — base/saved model mount (RO)
+  /content/artifacts     — output mount (RW, durable bucket)
+  ports: 8080 (serve), 8888 (notebook)
+plus the env-var convention PARAM_{NAME} (documented in the reference but
+implemented only as a file mount there; here both halves are real).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+CONTENT_DIR = os.environ.get("RBT_CONTENT_DIR", "/content")
+SERVE_PORT = 8080
+NOTEBOOK_PORT = 8888
+
+
+def content_path(*parts: str) -> str:
+    return os.path.join(CONTENT_DIR, *parts)
+
+
+def data_dir() -> str:
+    return content_path("data")
+
+
+def model_dir() -> str:
+    return content_path("model")
+
+
+def artifacts_dir() -> str:
+    return content_path("artifacts")
+
+
+def load_params(path: Optional[str] = None) -> Dict[str, Any]:
+    """Merge params.json (if present) with PARAM_* env vars (env wins).
+
+    PARAM_FOO_BAR=x corresponds to params key "foo_bar". Values are parsed as
+    JSON when possible, else kept as strings.
+    """
+    params: Dict[str, Any] = {}
+    path = path or content_path("params.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            params.update(json.load(f))
+    for key, val in os.environ.items():
+        if not key.startswith("PARAM_"):
+            continue
+        name = key[len("PARAM_"):].lower()
+        try:
+            params[name] = json.loads(val)
+        except (json.JSONDecodeError, ValueError):
+            params[name] = val
+    return params
+
+
+def params_to_env(params: Dict[str, Any]) -> Dict[str, str]:
+    """The operator-side half: params dict -> PARAM_* env map."""
+    env = {}
+    for key, val in params.items():
+        name = "PARAM_" + re.sub(r"[^A-Za-z0-9]", "_", str(key)).upper()
+        env[name] = val if isinstance(val, str) else json.dumps(val)
+    return env

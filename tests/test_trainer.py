@@ -1,0 +1,74 @@
+"""Trainer workload tests: params.json -> training -> artifacts, and resume."""
+
+import json
+import os
+
+from runbooks_tpu.parallel.mesh import MeshConfig
+from runbooks_tpu.train.lora import LoraConfig
+from runbooks_tpu.train.optimizer import OptimizerConfig
+from runbooks_tpu.train.trainer import TrainJobConfig, run_training
+from runbooks_tpu.utils import contract
+
+
+def job(tmp_path, steps=6, data_path=None, **kw):
+    return TrainJobConfig(
+        model="debug", model_overrides={"dtype": "float32"},
+        mesh=MeshConfig(data=2, fsdp=2, sequence=1, tensor=2),
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=0,
+                                  total_steps=100, schedule="constant"),
+        batch_size=4, seq_len=32, steps=steps,
+        checkpoint_every=3, log_every=2,
+        artifacts_dir=str(tmp_path), data_path=data_path, **kw,
+    )
+
+
+def test_training_writes_artifacts_and_metrics(tmp_path):
+    summary = run_training(job(tmp_path))
+    assert summary["final_loss"] is not None
+    assert os.path.exists(tmp_path / "metrics.json")
+    assert os.path.isdir(tmp_path / "checkpoints")
+    steps = os.listdir(tmp_path / "checkpoints")
+    assert "6" in steps
+
+
+def test_training_resumes_from_checkpoint(tmp_path):
+    run_training(job(tmp_path, steps=3))
+    # Second run with more steps resumes at 3, trains to 6.
+    summary = run_training(job(tmp_path, steps=6))
+    assert summary["history"][0]["step"] > 3 or summary["history"][0]["step"] == 4
+
+
+def test_trainer_from_params_json(tmp_path):
+    params = {
+        "model": "debug", "steps": 4, "batch_size": 2, "seq_len": 16,
+        "mesh_data": 1, "mesh_fsdp": 8, "mesh_tensor": 1,
+        "learning_rate": 1e-3, "checkpoint_every": 10,
+        "artifacts_dir": str(tmp_path),
+        "model_overrides": {"dtype": "float32"},
+    }
+    j = TrainJobConfig.from_params(params)
+    assert j.mesh.fsdp == 8 and j.steps == 4
+    summary = run_training(j)
+    assert summary["steps"] == 4
+
+
+def test_trainer_with_jsonl_data_and_lora(tmp_path):
+    data = tmp_path / "data"
+    os.makedirs(data)
+    with open(data / "docs.jsonl", "w") as f:
+        for i in range(30):
+            f.write(json.dumps({"text": f"document number {i} " * 3}) + "\n")
+    summary = run_training(job(
+        tmp_path, steps=4, data_path=str(data), lora=LoraConfig(rank=2)))
+    assert summary["lora"] is True
+    assert os.path.exists(tmp_path / "lora.json")
+
+
+def test_params_env_roundtrip(monkeypatch):
+    monkeypatch.setenv("PARAM_STEPS", "7")
+    monkeypatch.setenv("PARAM_MODEL", "debug")
+    params = contract.load_params(path="/nonexistent/params.json")
+    assert params["steps"] == 7
+    assert params["model"] == "debug"
+    env = contract.params_to_env({"steps": 7, "model": "debug"})
+    assert env == {"PARAM_STEPS": "7", "PARAM_MODEL": "debug"}
