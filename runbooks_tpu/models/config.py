@@ -60,6 +60,11 @@ class ModelConfig:
     tie_embeddings: bool = False
     embed_scale: bool = False         # multiply embeddings by sqrt(hidden)
 
+    # Attention implementation: "xla" (fused-by-XLA reference), "flash"
+    # (Pallas blockwise kernel), "ring" (sequence-parallel ring attention
+    # over the "sequence" mesh axis; shard_map + ppermute).
+    attention_impl: str = "xla"
+
     # Dtypes
     dtype: str = "bfloat16"           # activation dtype
     param_dtype: str = "float32"      # master param dtype

@@ -206,11 +206,66 @@ def _activation(cfg: ModelConfig, x: jax.Array) -> jax.Array:
     return jax.nn.relu(x)
 
 
+def _dispatch_attention(cfg: ModelConfig, q, k, v, positions, segment_ids,
+                        mask, bias):
+    """Pick the attention implementation for the no-cache (training) path.
+    k/v stay at kv_heads width on every path (GQA-native kernels)."""
+    impl = cfg.attention_impl
+    if impl not in ("xla", "flash", "ring"):
+        raise ValueError(
+            f"unknown attention_impl {impl!r}; expected xla|flash|ring")
+    if bias is not None or cfg.logit_softcap is not None:
+        impl = "xla"  # ALiBi bias / softcap not yet in the kernels
+
+    if impl == "flash":
+        from runbooks_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, positions, positions, segment_ids, segment_ids,
+            True, None)
+
+    if impl == "ring":
+        from runbooks_tpu.parallel.ring_attention import ring_attention
+        from runbooks_tpu.parallel.sharding import (
+            _current_mesh, spec_for_array)
+
+        mesh = _current_mesh()
+        if mesh is None or mesh.shape.get("sequence", 1) == 1:
+            # No ring to run; single-shard blockwise math is plain attention.
+            return dot_product_attention(
+                q, k, v, mask=mask, logit_softcap=cfg.logit_softcap)
+        qspec = spec_for_array(q.shape, ("batch", "seq", "act_heads", None),
+                               mesh)
+        kspec = spec_for_array(k.shape, ("batch", "seq", "act_heads", None),
+                               mesh)
+        rspec = spec_for_array(positions.shape, ("batch", "seq"), mesh)
+        seg = (segment_ids if segment_ids is not None
+               else jnp.ones_like(positions))
+
+        def local(ql, kl, vl, pl_, sl):
+            return ring_attention(ql, kl, vl, pl_, pl_, sl, sl,
+                                  axis_name="sequence")
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(qspec, kspec, kspec, rspec, rspec),
+            out_specs=qspec,
+            # The scan carry starts unvarying (zeros) and becomes varying
+            # after the first ppermute; skip the VMA check rather than
+            # pcast-annotating for every possible mesh shape.
+            check_vma=False,
+        )(q, k, v, positions, seg)
+
+    return dot_product_attention(q, k, v, mask=mask, bias=bias,
+                                 logit_softcap=cfg.logit_softcap)
+
+
 def _attention_block(
     cfg: ModelConfig,
     p: Params,
     x: jax.Array,                      # [b, s, h] activation dtype
     positions: jax.Array,              # [b, s]
+    segment_ids: Optional[jax.Array],
     mask: Optional[jax.Array],
     bias: Optional[jax.Array],
     layer_cache: Optional[Tuple[jax.Array, jax.Array, jax.Array]],
@@ -246,11 +301,13 @@ def _attention_block(
         cv = jax.lax.dynamic_update_slice(cv, v, (0, index, 0, 0))
         k, v = ck, cv
         new_layer_cache = (ck, cv)
-
-    out = dot_product_attention(
-        q, k, v, mask=mask, bias=bias,
-        logit_softcap=cfg.logit_softcap,
-    )
+        # Decode/prefill-with-cache always uses the XLA path (kernels cover
+        # the training shapes; cache attention is bandwidth-bound anyway).
+        out = dot_product_attention(
+            q, k, v, mask=mask, bias=bias, logit_softcap=cfg.logit_softcap)
+    else:
+        out = _dispatch_attention(cfg, q, k, v, positions, segment_ids,
+                                  mask, bias)
     out = out.reshape(b, s, cfg.q_dim)
     out = jnp.einsum("bsd,dh->bsh", out, p["wo"].astype(ad),
                      preferred_element_type=jnp.float32).astype(ad)
@@ -285,13 +342,14 @@ def _mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
     return out
 
 
-def _block(cfg: ModelConfig, layer: Params, x, positions, mask, bias,
-           layer_cache):
+def _block(cfg: ModelConfig, layer: Params, x, positions, segment_ids, mask,
+           bias, layer_cache):
     """One transformer block. x: [b, s, h]."""
     x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
     h1 = _norm(cfg, layer["ln1"], x)
     attn_out, new_cache = _attention_block(
-        cfg, layer["attn"], h1, positions, mask, bias, layer_cache)
+        cfg, layer["attn"], h1, positions, segment_ids, mask, bias,
+        layer_cache)
     if cfg.parallel_block:
         h2 = h1 if cfg.shared_layer_norm else _norm(cfg, layer["ln2"], x)
         mlp_out = _mlp_block(cfg, layer["mlp"], h2)
@@ -359,8 +417,12 @@ def forward(
         mask = make_attention_mask(positions, kv_positions, causal=True)
     else:
         kv_positions = positions
-        mask = make_attention_mask(
-            positions, kv_positions, segment_ids, segment_ids, causal=True)
+        if cfg.attention_impl == "flash" and cfg.position_type != "alibi" \
+                and cfg.logit_softcap is None:
+            mask = None  # the kernel masks from positions/segments directly
+        else:
+            mask = make_attention_mask(
+                positions, kv_positions, segment_ids, segment_ids, causal=True)
 
     bias = None
     if cfg.position_type == "alibi":
@@ -382,7 +444,8 @@ def forward(
         else:
             layer = scanned
             layer_cache = None
-        x, new_cache = block(cfg, layer, x, positions, mask, bias, layer_cache)
+        x, new_cache = block(cfg, layer, x, positions, segment_ids, mask,
+                             bias, layer_cache)
         return x, new_cache
 
     if cache is not None:

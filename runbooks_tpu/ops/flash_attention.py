@@ -1,0 +1,435 @@
+"""Pallas TPU flash attention (blockwise, O(seq) memory) with custom VJP.
+
+Design (see /opt/skills/guides/pallas_guide.md):
+- Grid (batch, heads, q_blocks, kv_blocks); TPU executes the grid sequentially
+  with the last dimension innermost, so the kernel accumulates the softmax
+  running state (m, l, acc) across kv-block iterations in VMEM scratch and
+  finalizes on the last kv block.
+- fp32 accumulation throughout; inputs may be bf16.
+- Masking is by absolute position (causal) + optional segment ids (packed
+  sequences), matching runbooks_tpu.ops.attention semantics so the XLA path
+  is a drop-in numerical oracle.
+- Backward: standard flash backward from saved logsumexp — one kernel for dq
+  (grid over q blocks) and one for dk/dv (grid over kv blocks), both
+  recomputing p blockwise.
+- GQA-native: k/v stay at kv_heads width; the BlockSpec index map routes
+  q head hi to kv head hi // n_rep, so no repeated k/v is ever materialized.
+
+On non-TPU backends the kernels run in interpreter mode (tests); use
+``attention_impl="xla"`` (the default) where Mosaic is unavailable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,  # prefetch-ish
+                q_ref, k_ref, v_ref,
+                o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, use_segments: bool):
+    kv_idx = pl.program_id(3)
+    num_kv = pl.num_programs(3)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+    k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+    v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # [bq, bk]
+
+    mask = jnp.ones_like(s, dtype=jnp.bool_)
+    if causal:
+        qp = q_pos_ref[0][:, None]                        # [bq, 1]
+        kp = kv_pos_ref[0][None, :]                       # [1, bk]
+        mask = jnp.logical_and(mask, kp <= qp)
+    if use_segments:
+        qs = q_seg_ref[0][:, None]
+        ks = kv_seg_ref[0][None, :]
+        mask = jnp.logical_and(mask, qs == ks)
+        mask = jnp.logical_and(mask, ks != 0)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[:]                                     # [bq, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # Rows with no valid key yet keep m == NEG_INF; guard the exp shift.
+    m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(mask, p, 0.0)
+
+    alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+    l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)              # fully-masked rows
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        m = m_scr[:]
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0] = lse[:, 0]
+
+
+def _pad_to(x, size, axis, value=0):
+    pad = size - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
+               block_q, block_k):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kv_h = k.shape[2]
+    n_rep = h // kv_h
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    sq_p = pl.cdiv(sq, block_q) * block_q
+    sk_p = pl.cdiv(sk, block_k) * block_k
+
+    # Layout [b, h, s, d] for kernel-friendly blocking.
+    qT = _pad_to(jnp.swapaxes(q, 1, 2), sq_p, 2)
+    kT = _pad_to(jnp.swapaxes(k, 1, 2), sk_p, 2)
+    vT = _pad_to(jnp.swapaxes(v, 1, 2), sk_p, 2)
+    # Padding keys get segment 0 + positions beyond any query so that causal
+    # and segment masks both kill them. Padding queries produce garbage rows
+    # that are sliced off.
+    q_pos_p = _pad_to(q_pos.astype(jnp.int32), sq_p, 1, value=0)
+    kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), sk_p, 1, value=2**30)
+    use_segments = q_seg is not None
+    if use_segments:
+        q_seg_p = _pad_to(q_seg.astype(jnp.int32), sq_p, 1, value=0)
+        kv_seg_p = _pad_to(kv_seg.astype(jnp.int32), sk_p, 1, value=0)
+    else:
+        q_seg_p = jnp.zeros_like(q_pos_p)
+        kv_seg_p = jnp.zeros_like(kv_pos_p)
+
+    grid = (b, h, sq_p // block_q, sk_p // block_k)
+
+    def q_map(bi, hi, qi, ki):
+        return (bi, hi, qi, 0)
+
+    def kv_map(bi, hi, qi, ki):
+        # GQA: q head hi reads kv head hi // n_rep — no repeated HBM copy.
+        return (bi, hi // n_rep, ki, 0)
+
+    def qrow_map(bi, hi, qi, ki):
+        return (bi, qi)
+
+    def krow_map(bi, hi, qi, ki):
+        return (bi, ki)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, use_segments=use_segments)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q), qrow_map),                 # q_pos
+            pl.BlockSpec((1, block_k), krow_map),                 # kv_pos
+            pl.BlockSpec((1, block_q), qrow_map),                 # q_seg
+            pl.BlockSpec((1, block_k), krow_map),                 # kv_seg
+            pl.BlockSpec((1, 1, block_q, d), q_map),              # q
+            pl.BlockSpec((1, 1, block_k, d), kv_map),             # k
+            pl.BlockSpec((1, 1, block_k, d), kv_map),             # v
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_pos_p, kv_pos_p, q_seg_p, kv_seg_p, qT, kT, vT)
+
+    out = jnp.swapaxes(out[:, :, :sq], 1, 2)          # [b, sq, h, d]
+    return out, lse[:, :, :sq]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,
+                   q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, scale, causal, use_segments):
+    kv_idx = pl.program_id(3)
+    num_kv = pl.num_programs(3)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]                              # [bq, 1]
+    delta = delta_ref[0, 0][:, None]                          # [bq, 1]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = jnp.ones_like(s, dtype=jnp.bool_)
+    if causal:
+        mask = jnp.logical_and(mask,
+                               kv_pos_ref[0][None, :] <= q_pos_ref[0][:, None])
+    if use_segments:
+        mask = jnp.logical_and(mask,
+                               q_seg_ref[0][:, None] == kv_seg_ref[0][None, :])
+        mask = jnp.logical_and(mask, kv_seg_ref[0][None, :] != 0)
+    lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
+    p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,
+                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, use_segments):
+    q_idx = pl.program_id(3)
+    num_q = pl.num_programs(3)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = jnp.ones_like(s, dtype=jnp.bool_)
+    if causal:
+        mask = jnp.logical_and(mask,
+                               kv_pos_ref[0][None, :] <= q_pos_ref[0][:, None])
+    if use_segments:
+        mask = jnp.logical_and(mask,
+                               q_seg_ref[0][:, None] == kv_seg_ref[0][None, :])
+        mask = jnp.logical_and(mask, kv_seg_ref[0][None, :] != 0)
+    lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
+    p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)        # [bq, bk]
+
+    dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale                          # [bq, bk]
+    dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def flash_attention(
+    q: jax.Array,                      # [b, sq, h, d]
+    k: jax.Array,                      # [b, sk, kv_h, d] (kv_h divides h)
+    v: jax.Array,
+    q_positions: jax.Array,            # [b, sq] int32
+    kv_positions: jax.Array,           # [b, sk] int32
+    q_segment_ids: Optional[jax.Array],   # [b, sq] or None
+    kv_segment_ids: Optional[jax.Array],  # [b, sk] or None
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
+    out, _ = _flash_fwd(
+        q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
+        scale if scale is not None else q.shape[-1] ** -0.5, causal,
+        block_q, block_k)
+    return out
+
+
+def _vjp_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg,
+             causal, scale, block_q, block_k):
+    scale_v = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg,
+                          scale_v, causal, block_q, block_k)
+    return out, (q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse)
+
+
+def _vjp_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse = res
+    scale_v = scale if scale is not None else q.shape[-1] ** -0.5
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kv_h = k.shape[2]
+    n_rep = h // kv_h
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    sq_p = pl.cdiv(sq, block_q) * block_q
+    sk_p = pl.cdiv(sk, block_k) * block_k
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                # [b, sq, h]
+    deltaT = _pad_to(jnp.swapaxes(delta, 1, 2), sq_p, 2)     # [b, h, sq_p]
+    lseT = _pad_to(lse, sq_p, 2, value=NEG_INF)
+    qT = _pad_to(jnp.swapaxes(q, 1, 2), sq_p, 2)
+    kT = _pad_to(jnp.swapaxes(k, 1, 2), sk_p, 2)
+    vT = _pad_to(jnp.swapaxes(v, 1, 2), sk_p, 2)
+    doT = _pad_to(jnp.swapaxes(g, 1, 2), sq_p, 2)
+    q_pos_p = _pad_to(q_pos.astype(jnp.int32), sq_p, 1, value=-(2**30))
+    kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), sk_p, 1, value=2**30)
+    use_segments = q_seg is not None
+    if use_segments:
+        q_seg_p = _pad_to(q_seg.astype(jnp.int32), sq_p, 1, value=0)
+        kv_seg_p = _pad_to(kv_seg.astype(jnp.int32), sk_p, 1, value=0)
+    else:
+        q_seg_p = jnp.zeros_like(q_pos_p)
+        kv_seg_p = jnp.zeros_like(kv_pos_p)
+
+    def qrow(bi, hi, i, j):
+        return (bi, i)
+
+    def krow(bi, hi, i, j):
+        return (bi, j)
+
+    def hq(bi, hi, i, j):
+        return (bi, hi, i, 0)
+
+    def hk(bi, hi, i, j):
+        return (bi, hi // n_rep, j, 0)
+
+    def hrow_q(bi, hi, i, j):
+        return (bi, hi, i)
+
+    # dq: grid inner dim iterates kv blocks
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale_v, causal=causal,
+                          use_segments=use_segments),
+        grid=(b, h, sq_p // block_q, sk_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q), qrow),
+            pl.BlockSpec((1, block_k), krow),
+            pl.BlockSpec((1, block_q), qrow),
+            pl.BlockSpec((1, block_k), krow),
+            pl.BlockSpec((1, 1, block_q, d), hq),
+            pl.BlockSpec((1, 1, block_k, d), hk),
+            pl.BlockSpec((1, 1, block_k, d), hk),
+            pl.BlockSpec((1, 1, block_q, d), hq),
+            pl.BlockSpec((1, 1, block_q), hrow_q),
+            pl.BlockSpec((1, 1, block_q), hrow_q),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), hq),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q_pos_p, kv_pos_p, q_seg_p, kv_seg_p, qT, kT, vT, doT, lseT, deltaT)
+
+    # dk/dv: grid inner dim iterates q blocks
+    def hq2(bi, hi, j, i):
+        return (bi, hi, i, 0)
+
+    def hk2_read(bi, hi, j, i):
+        return (bi, hi // n_rep, j, 0)
+
+    def hk2_write(bi, hi, j, i):
+        return (bi, hi, j, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale_v, causal=causal,
+                          use_segments=use_segments),
+        grid=(b, h, sk_p // block_k, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q), lambda bi, hi, j, i: (bi, i)),
+            pl.BlockSpec((1, block_k), lambda bi, hi, j, i: (bi, j)),
+            pl.BlockSpec((1, block_q), lambda bi, hi, j, i: (bi, i)),
+            pl.BlockSpec((1, block_k), lambda bi, hi, j, i: (bi, j)),
+            pl.BlockSpec((1, 1, block_q, d), hq2),
+            pl.BlockSpec((1, 1, block_k, d), hk2_read),
+            pl.BlockSpec((1, 1, block_k, d), hk2_read),
+            pl.BlockSpec((1, 1, block_q, d), hq2),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, j, i: (bi, hi, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, j, i: (bi, hi, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), hk2_write),
+            pl.BlockSpec((1, 1, block_k, d), hk2_write),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_pos_p, kv_pos_p, q_seg_p, kv_seg_p, qT, kT, vT, doT, lseT, deltaT)
+
+    dq = jnp.swapaxes(dq[:, :, :sq], 1, 2)
+    # dk/dv come back at full q-head width; fold the n_rep group back onto
+    # each kv head (sum over the query heads sharing it).
+    dk = dk.reshape(b, kv_h, n_rep, sk_p, d).sum(axis=2)[:, :, :sk]
+    dv = dv.reshape(b, kv_h, n_rep, sk_p, d).sum(axis=2)[:, :, :sk]
+    dk = jnp.swapaxes(dk, 1, 2).astype(k.dtype)
+    dv = jnp.swapaxes(dv, 1, 2).astype(v.dtype)
+    return dq, dk, dv, None, None, None, None
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

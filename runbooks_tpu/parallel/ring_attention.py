@@ -1,0 +1,109 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context training shards the sequence axis across devices ("sequence"
+mesh axis). Naive attention would all-gather the full K/V (O(seq) memory per
+chip); ring attention instead rotates the local K/V shard around the ring
+with ``lax.ppermute`` while accumulating blockwise-softmax partial results,
+so per-chip memory stays O(seq/ring) and the permute overlaps with compute.
+(SURVEY.md §5.7: the reference has no long-context support at all — this is
+net-new, first-class.)
+
+Correctness under sharding falls out of the absolute-position masking
+convention shared with ops.attention / ops.flash_attention: each shard owns
+its positions/segment ids, so causality and packing need no global index
+arithmetic. Gradients flow through ``ppermute`` (its transpose is the reverse
+permute), giving exact ring-attention backward via autodiff.
+
+Call *inside* ``jax.shard_map`` with q/k/v already sequence-sharded — or use
+``runbooks_tpu.models.transformer`` with ``attention_impl="ring"`` which does
+the shard_map plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,                       # [b, sq_local, h, d]
+    k: jax.Array,                       # [b, sk_local, kv_h, d] (GQA ok)
+    v: jax.Array,
+    q_positions: jax.Array,             # [b, sq_local] absolute positions
+    kv_positions: jax.Array,            # [b, sk_local]
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    axis_name: str = "sequence",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over the ring; returns [b, sq_local, h, d].
+
+    GQA keeps k/v at kv_heads width — ppermute traffic is per kv head, not
+    per q head. The scan step is rematerialized (jax.checkpoint) so backward
+    recomputes each step's probability block instead of saving it, keeping
+    training memory O(seq/ring) as advertised.
+    """
+    b, sq, h, d = q.shape
+    kv_h = k.shape[2]
+    n_rep = h // kv_h
+    scale = scale if scale is not None else d ** -0.5
+    n = jax.lax.axis_size(axis_name)
+    # [b, sq, g, r, d]: query heads grouped by the kv head they read.
+    qf = q.astype(jnp.float32).reshape(b, sq, kv_h, n_rep, d)
+
+    def partial_attn(kc, vc, kp, ks):
+        s = jnp.einsum("bqgrd,bkgd->bgrqk", qf, kc.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        mask = jnp.ones_like(s, dtype=jnp.bool_)
+        if causal:
+            mask &= kp[:, None, None, None, :] <= \
+                q_positions[:, None, None, :, None]
+        if q_segment_ids is not None:
+            mask &= q_segment_ids[:, None, None, :, None] == \
+                ks[:, None, None, None, :]
+            mask &= ks[:, None, None, None, :] != 0
+        s = jnp.where(mask, s, NEG_INF)
+        m = jnp.max(s, axis=-1)                                  # [b,g,r,q]
+        m_safe = jnp.where(m <= NEG_INF, 0.0, m)
+        p = jnp.where(mask, jnp.exp(s - m_safe[..., None]), 0.0)
+        l = jnp.sum(p, axis=-1)                                  # [b,g,r,q]
+        o = jnp.einsum("bgrqk,bkgd->bgrqd", p, vc.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        return o, m, l
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @jax.checkpoint
+    def step(carry, _):
+        acc, m_run, l_run, kc, vc, kp, ks = carry
+        o, m, l = partial_attn(kc, vc, kp, ks)
+        m_new = jnp.maximum(m_run, m)
+        m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        alpha_old = jnp.where(m_run <= NEG_INF, 0.0, jnp.exp(m_run - m_safe))
+        alpha_new = jnp.where(m <= NEG_INF, 0.0, jnp.exp(m - m_safe))
+        acc = acc * alpha_old[..., None] + o * alpha_new[..., None]
+        l_run = l_run * alpha_old + l * alpha_new
+        # Rotate K/V (and their metadata) to the next ring position.
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        kp = jax.lax.ppermute(kp, axis_name, perm)
+        ks = jax.lax.ppermute(ks, axis_name, perm)
+        return (acc, m_new, l_run, kc, vc, kp, ks), None
+
+    acc0 = jnp.zeros((b, kv_h, n_rep, sq, d), jnp.float32)
+    m0 = jnp.full((b, kv_h, n_rep, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kv_h, n_rep, sq), jnp.float32)
+    ks0 = (kv_segment_ids if kv_segment_ids is not None
+           else jnp.zeros_like(kv_positions))
+    carry = (acc0, m0, l0, k, v, kv_positions, ks0)
+    (acc, _, l_run, *_), _ = jax.lax.scan(step, carry, None, length=n)
+
+    l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+    out = acc / l_safe[..., None]                        # [b,g,r,q,d]
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, sq, h, d)
+    return out.astype(q.dtype)

@@ -1,0 +1,134 @@
+"""Flash-attention kernel vs the XLA reference attention (the numerical
+oracle), forward and backward, in Pallas interpreter mode on CPU."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from runbooks_tpu.ops.attention import dot_product_attention, make_attention_mask
+from runbooks_tpu.ops.flash_attention import flash_attention
+
+
+def make_inputs(b=2, sq=128, sk=128, h=2, d=32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (b, sq, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, sk, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, sk, h, d), jnp.float32)
+    q_pos = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32)[None], (b, sq))
+    kv_pos = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32)[None], (b, sk))
+    return q, k, v, q_pos, kv_pos
+
+
+def oracle(q, k, v, q_pos, kv_pos, q_seg=None, kv_seg=None, causal=True):
+    mask = make_attention_mask(q_pos, kv_pos, q_seg, kv_seg, causal=causal)
+    return dot_product_attention(q, k, v, mask=mask)
+
+
+@pytest.mark.parametrize("block", [64, 128])
+def test_forward_matches_oracle_causal(block):
+    q, k, v, q_pos, kv_pos = make_inputs()
+    ref = oracle(q, k, v, q_pos, kv_pos)
+    got = flash_attention(q, k, v, q_pos, kv_pos, None, None, True, None,
+                          block, block)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_forward_non_divisible_seq():
+    q, k, v, q_pos, kv_pos = make_inputs(sq=100, sk=100)
+    ref = oracle(q, k, v, q_pos, kv_pos)
+    got = flash_attention(q, k, v, q_pos, kv_pos, None, None, True, None,
+                          64, 64)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_forward_with_segments():
+    b, s = 2, 128
+    q, k, v, q_pos, kv_pos = make_inputs(sq=s, sk=s)
+    # Two packed docs + padding tail; positions restart per segment.
+    seg = np.ones((b, s), np.int32)
+    seg[:, 48:96] = 2
+    seg[:, 96:] = 0
+    pos = np.concatenate([np.arange(48), np.arange(48), np.arange(32)])
+    pos = np.broadcast_to(pos, (b, s)).astype(np.int32)
+    seg, pos = jnp.asarray(seg), jnp.asarray(pos)
+    ref = oracle(q, k, v, pos, pos, seg, seg)
+    got = flash_attention(q, k, v, pos, pos, seg, seg, True, None, 64, 64)
+    # Padding rows (seg 0) are fully masked: oracle zeroes them; flash
+    # zeroes them too via the l==0 guard.
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_forward_bf16_close():
+    q, k, v, q_pos, kv_pos = make_inputs()
+    ref = oracle(q, k, v, q_pos, kv_pos)
+    got = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                          v.astype(jnp.bfloat16), q_pos, kv_pos, None, None)
+    assert float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref))) < 0.05
+
+
+def test_gqa_forward_and_grads():
+    b, s, h, kv_h, d = 1, 64, 4, 2, 16
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kv_h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kv_h, d), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, pos, pos, None, None, True, None, 32, 32)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(oracle(q, k, v, pos, pos)))
+
+    np.testing.assert_allclose(loss_flash(q, k, v), loss_ref(q, k, v),
+                               rtol=1e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_gradients_match_oracle():
+    q, k, v, q_pos, kv_pos = make_inputs(b=1, sq=96, sk=96, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, q_pos, kv_pos, None, None, True, None,
+                            32, 32)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = oracle(q, k, v, q_pos, kv_pos)
+        return jnp.sum(o * jnp.cos(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_gradients_with_segments():
+    b, s = 1, 64
+    q, k, v, _, _ = make_inputs(b=b, sq=s, sk=s, h=2, d=16, seed=3)
+    seg = np.ones((b, s), np.int32)
+    seg[:, 40:] = 0  # padding tail
+    pos = np.broadcast_to(np.arange(s), (b, s)).astype(np.int32)
+    seg, pos = jnp.asarray(seg), jnp.asarray(pos)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, pos, pos, seg, seg, True, None, 32, 32)
+        return jnp.sum(o)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(oracle(q, k, v, pos, pos, seg, seg))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name}")
