@@ -169,9 +169,18 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
 class KVCache:
     """Per-model KV cache, layers stacked on the leading axis.
 
-    k, v: [num_layers, batch, max_len, num_kv_heads, head_dim]
-    index: [] int32 — number of tokens already written (same for the batch;
-    per-sequence lengths are handled by the serving engine's position logic).
+    k, v: [num_layers, batch, cache_len, num_kv_heads, head_dim]
+    index: [] int32 — number of tokens already written (same for the whole
+    batch). Two write modes in ``forward``:
+
+    - scalar-index mode (positions omitted): tokens append at ``index``;
+      every row advances together.
+    - position-scatter mode (positions given): token j of row b writes to
+      slot ``positions[b, j]`` (clipped to cache_len-1). Rows advance
+      independently — this is what slot-based continuous batching uses.
+      Allocate with ``trash_slot=True`` (cache_len = max_len+1) and point
+      padding at slot max_len so pad tokens land in a slot no real query
+      ever attends (slot s is visible only to queries with position >= s).
     """
 
     k: jax.Array
@@ -179,8 +188,11 @@ class KVCache:
     index: jax.Array
 
     @classmethod
-    def create(cls, cfg: ModelConfig, batch: int, max_len: int) -> "KVCache":
-        shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    def create(cls, cfg: ModelConfig, batch: int, max_len: int,
+               trash_slot: bool = False) -> "KVCache":
+        cache_len = max_len + 1 if trash_slot else max_len
+        shape = (cfg.num_layers, batch, cache_len, cfg.num_kv_heads,
+                 cfg.head_dim)
         return cls(
             k=jnp.zeros(shape, cfg.activation_dtype),
             v=jnp.zeros(shape, cfg.activation_dtype),
@@ -297,8 +309,16 @@ def _attention_block(
     new_layer_cache = None
     if layer_cache is not None:
         ck, cv, index = layer_cache
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, index, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, index, 0, 0))
+        if index is None:
+            # Position-scatter mode: row b token j -> slot positions[b, j].
+            cache_len = ck.shape[1]
+            slot = jnp.clip(positions, 0, cache_len - 1)
+            b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
+            ck = ck.at[b_idx, slot].set(k)
+            cv = cv.at[b_idx, slot].set(v)
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, index, 0, 0))
         k, v = ck, cv
         new_layer_cache = (ck, cv)
         # Decode/prefill-with-cache always uses the XLA path (kernels cover
@@ -392,6 +412,10 @@ def forward(
             "batches without a cache, or one sequence per batch row with one."
         )
 
+    # With a cache, explicitly-passed positions select position-scatter
+    # writes (per-row slots); omitted positions select append-at-index.
+    scatter_mode = cache is not None and positions is not None
+
     if positions is None:
         if cache is not None:
             positions = cache.index + jnp.arange(s, dtype=jnp.int32)[None, :]
@@ -440,7 +464,7 @@ def forward(
         x = carry
         if cache is not None:
             layer, ck, cv = scanned
-            layer_cache = (ck, cv, cache.index)
+            layer_cache = (ck, cv, None if scatter_mode else cache.index)
         else:
             layer = scanned
             layer_cache = None
@@ -451,7 +475,8 @@ def forward(
     if cache is not None:
         x, (new_k, new_v) = jax.lax.scan(
             scan_body, x, (params["layers"], cache.k, cache.v))
-        new_cache = KVCache(k=new_k, v=new_v, index=cache.index + s)
+        new_index = cache.index if scatter_mode else cache.index + s
+        new_cache = KVCache(k=new_k, v=new_v, index=new_index)
     else:
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
         new_cache = None

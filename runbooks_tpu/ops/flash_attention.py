@@ -30,6 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+PAD_POS = 2 ** 30  # kv-position sentinel for padding; always masked
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
@@ -64,10 +65,11 @@ def _fwd_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,  # prefetch-ish
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale      # [bq, bk]
 
-    mask = jnp.ones_like(s, dtype=jnp.bool_)
+    kp = kv_pos_ref[0][None, :]                           # [1, bk]
+    mask = kp < PAD_POS  # padding keys are masked regardless of causality
+    mask = jnp.broadcast_to(mask, s.shape)
     if causal:
         qp = q_pos_ref[0][:, None]                        # [bq, 1]
-        kp = kv_pos_ref[0][None, :]                       # [1, bk]
         mask = jnp.logical_and(mask, kp <= qp)
     if use_segments:
         qs = q_seg_ref[0][:, None]
@@ -129,7 +131,7 @@ def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
     # and segment masks both kill them. Padding queries produce garbage rows
     # that are sliced off.
     q_pos_p = _pad_to(q_pos.astype(jnp.int32), sq_p, 1, value=0)
-    kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), sk_p, 1, value=2**30)
+    kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), sk_p, 1, value=PAD_POS)
     use_segments = q_seg is not None
     if use_segments:
         q_seg_p = _pad_to(q_seg.astype(jnp.int32), sq_p, 1, value=0)
@@ -212,7 +214,7 @@ def _bwd_dq_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-    mask = jnp.ones_like(s, dtype=jnp.bool_)
+    mask = jnp.broadcast_to(kv_pos_ref[0][None, :] < PAD_POS, s.shape)
     if causal:
         mask = jnp.logical_and(mask,
                                kv_pos_ref[0][None, :] <= q_pos_ref[0][:, None])
@@ -255,7 +257,7 @@ def _bwd_dkv_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-    mask = jnp.ones_like(s, dtype=jnp.bool_)
+    mask = jnp.broadcast_to(kv_pos_ref[0][None, :] < PAD_POS, s.shape)
     if causal:
         mask = jnp.logical_and(mask,
                                kv_pos_ref[0][None, :] <= q_pos_ref[0][:, None])
@@ -334,7 +336,7 @@ def _vjp_bwd(causal, scale, block_q, block_k, res, g):
     vT = _pad_to(jnp.swapaxes(v, 1, 2), sk_p, 2)
     doT = _pad_to(jnp.swapaxes(g, 1, 2), sq_p, 2)
     q_pos_p = _pad_to(q_pos.astype(jnp.int32), sq_p, 1, value=-(2**30))
-    kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), sk_p, 1, value=2**30)
+    kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), sk_p, 1, value=PAD_POS)
     use_segments = q_seg is not None
     if use_segments:
         q_seg_p = _pad_to(q_seg.astype(jnp.int32), sq_p, 1, value=0)

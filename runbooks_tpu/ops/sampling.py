@@ -1,0 +1,76 @@
+"""Token sampling: greedy / temperature / top-k / top-p, jit-friendly.
+
+Static-shape throughout (top-k uses lax.top_k with a static k; top-p masks
+the sorted tail) so one compiled sampler serves every request — request-level
+parameters are traced scalars, not Python branches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0      # 1.0 = disabled
+    # Static width of the sorted lane used for top-k/top-p (compile-time).
+    # Requests with top_k=0 AND top_p=1.0 sample the full vocab; requests
+    # using top_p are truncated to this lane (an explicit engineering cap —
+    # mass beyond the top max_top_k logits is negligible for real models).
+    max_top_k: int = 64
+
+
+def sample(
+    logits: jax.Array,              # [batch, vocab] float32
+    rng: jax.Array,
+    temperature: jax.Array,         # [batch] or scalar; 0 => greedy
+    top_k: jax.Array,               # [batch] int32; 0 => disabled
+    top_p: jax.Array,               # [batch] float32; 1.0 => disabled
+    max_top_k: int = 64,
+) -> jax.Array:
+    """Returns sampled token ids [batch]."""
+    vocab = logits.shape[-1]
+    temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32),
+                                   logits.shape[:1])
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), logits.shape[:1])
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), logits.shape[:1])
+
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # Temperature (guard 0 -> greedy path selected at the end).
+    temp_safe = jnp.where(temperature <= 0.0, 1.0, temperature)
+    scaled = logits / temp_safe[:, None]
+
+    # Top-k over a static-width lane.
+    k_cap = min(max_top_k, vocab)
+    top_vals, top_idx = jax.lax.top_k(scaled, k_cap)       # [b, k_cap] sorted
+    ranks = jnp.arange(k_cap, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(top_k <= 0, k_cap, jnp.minimum(top_k, k_cap))
+    keep_k = ranks < k_eff[:, None]
+
+    # Top-p on the sorted lane: keep the smallest prefix with cumprob >= p
+    # (always keep the first token).
+    probs = jax.nn.softmax(jnp.where(keep_k, top_vals, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (cum - probs) < top_p[:, None]
+    keep = keep_k & keep_p
+    keep = keep.at[:, 0].set(True)
+
+    masked = jnp.where(keep, top_vals, -jnp.inf)
+    rng_lane, rng_full = jax.random.split(rng)
+    choice = jax.random.categorical(rng_lane, masked, axis=-1)  # lane space
+    lane_sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0]
+
+    # top_k=0 and top_p=1.0 => unrestricted sampling over the full vocab
+    # (the lane would otherwise silently cap the distribution at max_top_k).
+    full_sampled = jax.random.categorical(rng_full, scaled, axis=-1)
+    restricted = (top_k > 0) | (top_p < 1.0)
+    sampled = jnp.where(restricted, lane_sampled, full_sampled)
+
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -80,7 +80,13 @@ def ring_attention(
 
     @jax.checkpoint
     def step(carry, _):
+        # Rotate first, then fold in — so after n-1 scan steps every shard
+        # has been visited with no wasted final ppermute.
         acc, m_run, l_run, kc, vc, kp, ks = carry
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        kp = jax.lax.ppermute(kp, axis_name, perm)
+        ks = jax.lax.ppermute(ks, axis_name, perm)
         o, m, l = partial_attn(kc, vc, kp, ks)
         m_new = jnp.maximum(m_run, m)
         m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
@@ -88,20 +94,18 @@ def ring_attention(
         alpha_new = jnp.where(m <= NEG_INF, 0.0, jnp.exp(m - m_safe))
         acc = acc * alpha_old[..., None] + o * alpha_new[..., None]
         l_run = l_run * alpha_old + l * alpha_new
-        # Rotate K/V (and their metadata) to the next ring position.
-        kc = jax.lax.ppermute(kc, axis_name, perm)
-        vc = jax.lax.ppermute(vc, axis_name, perm)
-        kp = jax.lax.ppermute(kp, axis_name, perm)
-        ks = jax.lax.ppermute(ks, axis_name, perm)
         return (acc, m_new, l_run, kc, vc, kp, ks), None
 
-    acc0 = jnp.zeros((b, kv_h, n_rep, sq, d), jnp.float32)
-    m0 = jnp.full((b, kv_h, n_rep, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, kv_h, n_rep, sq), jnp.float32)
     ks0 = (kv_segment_ids if kv_segment_ids is not None
            else jnp.zeros_like(kv_positions))
-    carry = (acc0, m0, l0, k, v, kv_positions, ks0)
-    (acc, _, l_run, *_), _ = jax.lax.scan(step, carry, None, length=n)
+    # Step 0: the local shard, un-rotated, seeds the running state directly
+    # (partial_attn already zeroes fully-masked rows).
+    o0, m0, l0 = partial_attn(k, v, kv_positions, ks0)
+    carry = (o0, m0, l0, k, v, kv_positions, ks0)
+    if n > 1:
+        (acc, _, l_run, *_), _ = jax.lax.scan(step, carry, None, length=n - 1)
+    else:
+        acc, _, l_run = carry[0], carry[1], carry[2]
 
     l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
     out = acc / l_safe[..., None]                        # [b,g,r,q,d]

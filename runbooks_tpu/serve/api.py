@@ -1,0 +1,249 @@
+"""OpenAI-compatible HTTP serving on the container contract.
+
+Serves /v1/completions on port 8080 with readiness at GET / — the exact
+surface the reference's Server resource expects of a serving container
+(reference: internal/controller/server_controller.go readiness probe GET /
+port 8080 "http-serve"; test/system.sh curls /v1/completions). The engine
+behind it does slot-based continuous batching (serve/engine.py).
+
+Run: ``python -m runbooks_tpu.serve.api`` (reads /content/params.json:
+model, checkpoint, max_slots, port, tokenizer) or programmatically via
+``create_server``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Any, Optional, Tuple
+
+from aiohttp import web
+
+from runbooks_tpu.models.config import ModelConfig, get_config
+from runbooks_tpu.serve.engine import InferenceEngine, Request
+from runbooks_tpu.train.data import load_tokenizer
+from runbooks_tpu.utils import contract
+
+
+def load_model(params: dict) -> Tuple[ModelConfig, Any]:
+    """Model from params.json: named config + optional orbax checkpoint under
+    the model mount (falls back to random init for smoke serving, mirroring
+    the reference's opt-125m kind-cluster smoke test)."""
+    import jax
+
+    cfg = get_config(params.get("model", "debug"),
+                     **params.get("model_overrides", {}))
+    ckpt_dir = params.get("checkpoint") or contract.model_dir()
+    import os
+
+    from runbooks_tpu.models.transformer import init_params
+
+    model_params = None
+    have_ckpt = os.path.isdir(os.path.join(ckpt_dir, "checkpoints"))
+    if have_ckpt:
+        from runbooks_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(ckpt_dir)
+        try:
+            if mgr.latest_step() is None:
+                have_ckpt = False
+            else:
+                # Checkpoints store a TrainState {step, params, opt_state};
+                # serving needs only params.
+                full = mgr.restore(None)
+                model_params = (full["params"] if isinstance(full, dict)
+                                else full.params)
+        finally:
+            mgr.close()
+    if model_params is None:
+        # Random init is only acceptable when there is genuinely nothing to
+        # load (smoke serving, like the reference's opt-125m kind test). A
+        # present-but-unreadable checkpoint must fail loudly, not serve
+        # garbage weights behind a healthy readiness probe.
+        if have_ckpt:
+            raise RuntimeError(
+                f"checkpoint exists under {ckpt_dir} but restore returned "
+                "no params")
+        model_params = jax.jit(lambda r: init_params(cfg, r))(
+            jax.random.key(params.get("seed", 0)))
+    return cfg, model_params
+
+
+class EngineWorker:
+    """Single thread that owns the engine: admits requests, steps the decode
+    loop, resolves futures of finished requests."""
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+        self._pending: list[Tuple[Request, Future]] = []
+        self._inflight: list[Tuple[Request, Future]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, req: Request) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            self._pending.append((req, fut))
+        self._wake.set()
+        return fut
+
+    def _run(self) -> None:
+        while not self._stop:
+            try:
+                with self._lock:
+                    for req, fut in self._pending:
+                        self.engine.submit(req)
+                        self._inflight.append((req, fut))
+                    self._pending.clear()
+                if not self.engine.has_work():
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                self.engine.step()
+                done = [(r, f) for r, f in self._inflight if r.finished]
+                if done:
+                    self._inflight = [(r, f) for r, f in self._inflight
+                                      if not r.finished]
+                    for req, fut in done:
+                        if not fut.done():
+                            fut.set_result(req)
+            except Exception as exc:  # noqa: BLE001 — engine step blew up
+                # Fail every waiting request with the error (hanging futures
+                # would wedge all HTTP handlers forever) and reset the slot
+                # state so subsequent requests get a clean engine.
+                with self._lock:
+                    doomed = self._inflight + self._pending
+                    self._inflight, self._pending = [], []
+                for _req, fut in doomed:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                self.engine.active[:] = False
+                self.engine.slot_req = [None] * self.engine.max_slots
+                self.engine.queue.clear()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=5)
+
+
+def create_server(cfg: ModelConfig, model_params, tokenizer=None,
+                  max_slots: int = 8,
+                  max_seq_len: Optional[int] = None) -> web.Application:
+    tokenizer = tokenizer or load_tokenizer(None)
+    engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
+                             max_seq_len=max_seq_len)
+    worker = EngineWorker(engine)
+    app = web.Application()
+    app["worker"] = worker
+    app["tokenizer"] = tokenizer
+    app["model_name"] = cfg.name
+    started = time.time()
+
+    async def root(request: web.Request) -> web.Response:
+        # Readiness probe target (reference probes GET / on the serve port).
+        return web.json_response({"status": "ok", "model": cfg.name,
+                                  "uptime_s": round(time.time() - started, 1)})
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def completions(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400)
+        prompt = body.get("prompt")
+        if prompt is None:
+            return web.json_response(
+                {"error": {"message": "missing required field: prompt"}},
+                status=400)
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        try:
+            max_tokens = int(body.get("max_tokens", 16))
+            temperature = float(body.get("temperature", 1.0))
+            top_p = float(body.get("top_p", 1.0))
+            top_k = int(body.get("top_k", 0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "malformed sampling parameters"}},
+                status=400)
+        if max_tokens < 1:
+            return web.json_response(
+                {"error": {"message": "max_tokens must be >= 1"}}, status=400)
+
+        tok = request.app["tokenizer"]
+        ids = tok.encode(prompt, add_bos=True, add_eos=False) \
+            if hasattr(tok, "bos_id") else tok.encode(prompt)
+        eos = getattr(tok, "eos_id", None) or getattr(tok, "eos_token_id",
+                                                      None)
+        req = Request(prompt_tokens=list(ids), max_tokens=max_tokens,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      eos_id=eos)
+        fut = request.app["worker"].submit(req)
+        try:
+            done = await asyncio.wait_for(asyncio.wrap_future(fut),
+                                          timeout=600)
+        except asyncio.TimeoutError:
+            return web.json_response(
+                {"error": {"message": "generation timed out"}}, status=504)
+        except Exception as exc:  # noqa: BLE001 — engine failure surfaced
+            return web.json_response(
+                {"error": {"message": f"engine failure: {exc}"}}, status=500)
+        out_ids = done.output_tokens
+        if eos is not None and out_ids and out_ids[-1] == eos:
+            out_ids = out_ids[:-1]
+        text = tok.decode(out_ids)
+        return web.json_response({
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": request.app["model_name"],
+            "choices": [{
+                "index": 0,
+                "text": text,
+                "finish_reason": done.finish_reason,
+                "logprobs": None,
+            }],
+            "usage": {
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(done.output_tokens),
+                "total_tokens": len(ids) + len(done.output_tokens),
+            },
+        })
+
+    app.router.add_get("/", root)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_post("/v1/completions", completions)
+
+    async def on_cleanup(app):
+        worker.stop()
+
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def main() -> int:
+    params = contract.load_params()
+    cfg, model_params = load_model(params)
+    tokenizer = load_tokenizer(params.get("tokenizer"))
+    app = create_server(
+        cfg, model_params, tokenizer,
+        max_slots=int(params.get("max_slots", 8)),
+        max_seq_len=params.get("max_seq_len"))
+    port = int(params.get("port", contract.SERVE_PORT))
+    web.run_app(app, port=port, print=lambda *a: None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

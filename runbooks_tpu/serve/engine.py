@@ -1,0 +1,235 @@
+"""Slot-based continuous-batching inference engine.
+
+The reference serves models via external HTTP containers (reference:
+examples/llama2-7b/server.yaml uses substratusai/model-server-basaran behind
+a Deployment on port 8080 — internal/controller/server_controller.go). Here
+inference is in-framework and TPU-shaped:
+
+- Static shapes everywhere: a fixed pool of B slots, a fixed cache length,
+  bucketed prefill lengths — so there are exactly (num_buckets + 1) compiled
+  programs (prefills + one decode step) and no recompiles at serve time.
+- Continuous batching at slot granularity: between decode steps, finished
+  slots are freed and queued requests prefill into free slots; every decode
+  step advances all active slots at once (one [B,1] forward).
+- Per-slot cache writes use the transformer's position-scatter mode with a
+  trash slot for padding (see models/transformer.KVCache).
+- Sampling is jitted with per-slot temperature/top_k/top_p so mixed request
+  parameters batch together.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from runbooks_tpu.models.config import ModelConfig
+from runbooks_tpu.models.transformer import KVCache, forward
+from runbooks_tpu.ops.sampling import sample
+
+Params = Any
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (engine-internal)."""
+    prompt_tokens: List[int]
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    # Filled by the engine:
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+    finish_reason: str = ""
+    _slot: int = -1
+
+
+def _buckets(max_prefill: int) -> List[int]:
+    out, b = [], 16
+    while b < max_prefill:
+        out.append(b)
+        b *= 2
+    out.append(max_prefill)
+    return out
+
+
+class InferenceEngine:
+    """Batched generation over a fixed slot pool. Thread-unsafe by design;
+    drive it from one loop (the API server wraps it in a single worker)."""
+
+    def __init__(self, cfg: ModelConfig, params: Params, *,
+                 max_slots: int = 8, max_seq_len: Optional[int] = None,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len or cfg.max_seq_len
+        self.cache = KVCache.create(cfg, max_slots, self.max_seq_len,
+                                    trash_slot=True)
+        self._pad_slot = self.max_seq_len  # trash slot index
+        self.lengths = np.zeros(max_slots, np.int32)       # tokens in cache
+        self.active = np.zeros(max_slots, bool)
+        self.last_token = np.zeros(max_slots, np.int32)
+        self.slot_req: List[Optional[Request]] = [None] * max_slots
+        self.queue: List[Request] = []
+        self.rng = jax.random.key(seed)
+        self.prefill_buckets = _buckets(self.max_seq_len)
+        self.steps = 0
+
+        cache_len = self.max_seq_len + 1
+
+        def prefill_fn(params, cache_k, cache_v, tokens, positions, slot):
+            # Prefill one request into a fresh zero row, then splice the row
+            # into the pool cache (donated => in-place, no full-cache copy).
+            # Stale data from the slot's previous occupant needs no clearing:
+            # this request's queries only ever attend slots <= their own
+            # position, all of which this prefill/decode has (re)written.
+            row_shape = (cfg.num_layers, 1, cache_len, cfg.num_kv_heads,
+                         cfg.head_dim)
+            cache1 = KVCache(
+                k=jnp.zeros(row_shape, cfg.activation_dtype),
+                v=jnp.zeros(row_shape, cfg.activation_dtype),
+                index=jnp.zeros((), jnp.int32))
+            logits, cache1 = forward(cfg, params, tokens,
+                                     positions=positions, cache=cache1)
+            new_k = jax.lax.dynamic_update_slice_in_dim(
+                cache_k, cache1.k, slot, axis=1)
+            new_v = jax.lax.dynamic_update_slice_in_dim(
+                cache_v, cache1.v, slot, axis=1)
+            return logits, new_k, new_v
+
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(1, 2))
+
+        def decode_fn(params, cache, tokens, positions, rng,
+                      temperature, top_k, top_p):
+            logits, cache = forward(cfg, params, tokens,
+                                    positions=positions, cache=cache)
+            next_tok = sample(logits[:, -1], rng, temperature, top_k, top_p)
+            return next_tok, cache
+
+        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    # Request lifecycle
+    # ------------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        if len(req.prompt_tokens) >= self.max_seq_len:
+            req.prompt_tokens = req.prompt_tokens[-(self.max_seq_len - 1):]
+        self.queue.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self.active.any())
+
+    def _free_slots(self) -> List[int]:
+        return [i for i in range(self.max_slots) if not self.active[i]]
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def _admit(self) -> None:
+        for slot in self._free_slots():
+            if not self.queue:
+                break
+            req = self.queue.pop(0)
+            self._prefill_into(slot, req)
+
+    def _prefill_into(self, slot: int, req: Request) -> None:
+        toks = req.prompt_tokens
+        n = len(toks)
+        bucket = self._bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = toks
+        # Real tokens at positions 0..n-1; padding scatters to the trash slot.
+        positions = np.full((1, bucket), self._pad_slot, np.int32)
+        positions[0, :n] = np.arange(n)
+
+        logits, new_k, new_v = self._prefill(
+            self.params, self.cache.k, self.cache.v, jnp.asarray(padded),
+            jnp.asarray(positions), jnp.asarray(slot, jnp.int32))
+        self.cache = KVCache(k=new_k, v=new_v, index=self.cache.index)
+        # First generated token comes from the last *real* prompt position.
+        self.rng, sub = jax.random.split(self.rng)
+        first = sample(
+            logits[:, n - 1], sub,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32))
+        tok = int(first[0])
+        self.active[slot] = True
+        self.lengths[slot] = n
+        self.last_token[slot] = tok
+        self.slot_req[slot] = req
+        req._slot = slot
+        self._record_token(slot, tok)
+
+    def _record_token(self, slot: int, tok: int) -> None:
+        req = self.slot_req[slot]
+        assert req is not None
+        req.output_tokens.append(tok)
+        hit_eos = req.eos_id is not None and tok == req.eos_id
+        out_len = len(req.output_tokens)
+        # lengths[slot] counts tokens written to the cache; the next decode
+        # writes at position lengths[slot], which must stay < max_seq_len
+        # (slot max_seq_len is the trash slot).
+        out_of_room = self.lengths[slot] >= self.max_seq_len
+        if hit_eos or out_len >= req.max_tokens or out_of_room:
+            req.finished = True
+            req.finish_reason = "stop" if hit_eos else "length"
+            self.active[slot] = False
+            self.slot_req[slot] = None
+
+    def step(self) -> int:
+        """Admit queued requests, run one decode step. Returns number of
+        active slots stepped."""
+        self._admit()
+        if not self.active.any():
+            return 0
+        tokens = jnp.asarray(self.last_token[:, None])
+        # Inactive rows decode into the trash slot at a harmless position.
+        positions = np.where(self.active, self.lengths,
+                             self._pad_slot).astype(np.int32)[:, None]
+        temps = np.array([self.slot_req[i].temperature if self.active[i]
+                          else 0.0 for i in range(self.max_slots)], np.float32)
+        top_ks = np.array([self.slot_req[i].top_k if self.active[i] else 0
+                           for i in range(self.max_slots)], np.int32)
+        top_ps = np.array([self.slot_req[i].top_p if self.active[i] else 1.0
+                           for i in range(self.max_slots)], np.float32)
+        self.rng, sub = jax.random.split(self.rng)
+        next_tok, self.cache = self._decode(
+            self.params, self.cache, tokens, jnp.asarray(positions), sub,
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps))
+        next_tok = np.asarray(next_tok)
+        stepped = 0
+        for slot in range(self.max_slots):
+            if not self.active[slot]:
+                continue
+            stepped += 1
+            self.lengths[slot] += 1
+            tok = int(next_tok[slot])
+            self.last_token[slot] = tok
+            self._record_token(slot, tok)
+        self.steps += 1
+        return stepped
+
+    # ------------------------------------------------------------------
+    # Convenience synchronous generation
+    # ------------------------------------------------------------------
+
+    def generate(self, requests: List[Request],
+                 timeout_s: float = 600.0) -> List[Request]:
+        for r in requests:
+            self.submit(r)
+        deadline = time.monotonic() + timeout_s
+        while self.has_work() and time.monotonic() < deadline:
+            self.step()
+        return requests

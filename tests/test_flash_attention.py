@@ -61,6 +61,16 @@ def test_forward_with_segments():
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
 
 
+def test_noncausal_with_padding_keys():
+    # Regression: with causal=False, zero-padded keys (sk not a block
+    # multiple) must still be masked out of the softmax denominator.
+    q, k, v, q_pos, kv_pos = make_inputs(sq=100, sk=100)
+    ref = oracle(q, k, v, q_pos, kv_pos, causal=False)
+    got = flash_attention(q, k, v, q_pos, kv_pos, None, None, False, None,
+                          64, 64)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
 def test_forward_bf16_close():
     q, k, v, q_pos, kv_pos = make_inputs()
     ref = oracle(q, k, v, q_pos, kv_pos)
