@@ -1,0 +1,38 @@
+"""Condition types + reasons for the runbooks-tpu resources.
+
+Capability mirror of the reference's condition vocabulary (reference:
+api/v1/conditions.go — Uploaded/Built/Complete/Serving + reasons), with one
+addition: Launched, used by multi-host TPU workloads to report pod-slice
+fan-out before completion.
+"""
+
+# Condition types
+UPLOADED = "Uploaded"
+BUILT = "Built"
+COMPLETE = "Complete"
+SERVING = "Serving"
+SUSPENDED = "Suspended"
+LAUNCHED = "Launched"
+
+# Reasons
+REASON_AWAITING_UPLOAD = "AwaitingUpload"
+REASON_UPLOAD_FOUND = "UploadFound"
+REASON_BUILD_JOB_RUNNING = "BuildJobRunning"
+REASON_BUILD_JOB_FAILED = "BuildJobFailed"
+REASON_BUILT = "ImageBuilt"
+REASON_JOB_RUNNING = "JobRunning"
+REASON_JOB_COMPLETE = "JobComplete"
+REASON_JOB_FAILED = "JobFailed"
+REASON_DEPLOYMENT_READY = "DeploymentReady"
+REASON_DEPLOYMENT_NOT_READY = "DeploymentNotReady"
+REASON_POD_READY = "PodReady"
+REASON_POD_NOT_READY = "PodNotReady"
+REASON_SUSPENDED = "Suspended"
+REASON_MODEL_NOT_FOUND = "ModelNotFound"
+REASON_MODEL_NOT_READY = "ModelNotReady"
+REASON_DATASET_NOT_FOUND = "DatasetNotFound"
+REASON_DATASET_NOT_READY = "DatasetNotReady"
+REASON_BASEMODEL_NOT_FOUND = "BaseModelNotFound"
+REASON_BASEMODEL_NOT_READY = "BaseModelNotReady"
+REASON_SLICE_PENDING = "PodSlicePending"
+REASON_SLICE_RUNNING = "PodSliceRunning"

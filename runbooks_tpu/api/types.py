@@ -1,0 +1,212 @@
+"""The four declarative resources: Model, Dataset, Server, Notebook.
+
+Capability parity with the reference's CRDs (reference: api/v1/
+model_types.go, dataset_types.go, server_types.go, notebook_types.go,
+common_types.go), redesigned TPU-first:
+
+- ``resources.tpu {type, topology}`` replaces ``resources.gpu {type, count}``
+  (reference: api/v1/common_types.go GPUType/GPUResources) and implies
+  multi-host pod-slice fan-out when the topology spans hosts.
+- Build sources (git | upload with md5/requestID handshake) and the
+  signed-URL upload status mirror the reference's contract so the same
+  dev-loop CLI flow works (reference: api/v1/common_types.go Build/
+  BuildUpload/UploadStatus).
+
+Objects are dict-backed (manifest shape in, manifest shape out); these
+classes are thin typed views, not an ORM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from runbooks_tpu.k8s import objects as ko
+
+GROUP = "runbooks-tpu.dev"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+KINDS = ("Model", "Dataset", "Server", "Notebook")
+
+DEFAULT_RESOURCES = {"cpu": 2, "memory": 10, "disk": 10}
+
+
+class Resource:
+    """Typed view over a dict-shaped custom resource."""
+
+    kind = ""
+
+    def __init__(self, obj: Dict[str, Any]):
+        assert obj.get("kind") == self.kind, (obj.get("kind"), self.kind)
+        self.obj = obj
+
+    # -- constructors --------------------------------------------------
+
+    @classmethod
+    def new(cls, name: str, namespace: str = "default",
+            spec: Optional[dict] = None) -> "Resource":
+        return cls(ko.new(API_VERSION, cls.kind, name, namespace,
+                          spec=spec or {}))
+
+    # -- generic accessors --------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return ko.name(self.obj)
+
+    @property
+    def namespace(self) -> str:
+        return ko.namespace(self.obj)
+
+    @property
+    def spec(self) -> dict:
+        return self.obj.setdefault("spec", {})
+
+    @property
+    def status(self) -> dict:
+        return self.obj.setdefault("status", {})
+
+    @property
+    def generation(self) -> int:
+        return ko.deep_get(self.obj, "metadata", "generation", default=0)
+
+    # -- build contract (BuildableObject analog) ----------------------
+
+    @property
+    def image(self) -> str:
+        return self.spec.get("image", "")
+
+    def set_image(self, image: str) -> None:
+        self.spec["image"] = image
+
+    @property
+    def build(self) -> Optional[dict]:
+        return self.spec.get("build")
+
+    @property
+    def build_upload(self) -> Optional[dict]:
+        b = self.build or {}
+        return b.get("upload")
+
+    @property
+    def build_git(self) -> Optional[dict]:
+        b = self.build or {}
+        return b.get("git")
+
+    @property
+    def upload_status(self) -> dict:
+        return self.status.setdefault("buildUpload", {})
+
+    # -- workload contract --------------------------------------------
+
+    @property
+    def command(self) -> List[str]:
+        return self.spec.get("command", [])
+
+    @property
+    def env(self) -> Dict[str, str]:
+        return self.spec.get("env", {})
+
+    @property
+    def params(self) -> Dict[str, Any]:
+        return self.spec.get("params", {})
+
+    @property
+    def resources(self) -> dict:
+        return {**DEFAULT_RESOURCES, **self.spec.get("resources", {})}
+
+    @property
+    def tpu(self) -> Optional[dict]:
+        return self.spec.get("resources", {}).get("tpu")
+
+    # -- status --------------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return bool(self.status.get("ready"))
+
+    def set_ready(self, ready: bool) -> None:
+        self.status["ready"] = ready
+
+    @property
+    def artifacts_url(self) -> str:
+        return self.status.get("artifacts", {}).get("url", "")
+
+    def set_artifacts_url(self, url: str) -> None:
+        self.status.setdefault("artifacts", {})["url"] = url
+
+    def set_condition(self, ctype: str, ok: bool, reason: str,
+                      message: str = "") -> bool:
+        return ko.set_condition(self.obj, ctype, ok, reason, message,
+                                self.generation)
+
+    def condition_true(self, ctype: str) -> bool:
+        return ko.is_condition_true(self.obj, ctype)
+
+
+class Model(Resource):
+    """A trained/imported model: running spec.command in spec.image writes
+    model artifacts to /content/artifacts (reference: api/v1/model_types.go
+    docstrings + container contract)."""
+
+    kind = "Model"
+
+    @property
+    def base_model_ref(self) -> Optional[str]:
+        ref = self.spec.get("model") or self.spec.get("baseModel")
+        return ref.get("name") if ref else None
+
+    @property
+    def dataset_ref(self) -> Optional[str]:
+        ref = self.spec.get("dataset")
+        return ref.get("name") if ref else None
+
+
+class Dataset(Resource):
+    """A dataset produced by a loader job writing /content/artifacts
+    (reference: api/v1/dataset_types.go)."""
+
+    kind = "Dataset"
+
+
+class Server(Resource):
+    """An HTTP inference server for a ready Model (reference:
+    api/v1/server_types.go — spec.model is required)."""
+
+    kind = "Server"
+
+    @property
+    def model_ref(self) -> Optional[str]:
+        ref = self.spec.get("model")
+        return ref.get("name") if ref else None
+
+
+class Notebook(Resource):
+    """A Jupyter workspace pod, suspendable (reference:
+    api/v1/notebook_types.go Suspend/IsSuspended)."""
+
+    kind = "Notebook"
+
+    @property
+    def suspended(self) -> bool:
+        return bool(self.spec.get("suspend"))
+
+    @property
+    def model_ref(self) -> Optional[str]:
+        ref = self.spec.get("model")
+        return ref.get("name") if ref else None
+
+    @property
+    def dataset_ref(self) -> Optional[str]:
+        ref = self.spec.get("dataset")
+        return ref.get("name") if ref else None
+
+
+KIND_TO_CLASS = {c.kind: c for c in (Model, Dataset, Server, Notebook)}
+
+
+def wrap(obj: Dict[str, Any]) -> Resource:
+    cls = KIND_TO_CLASS.get(obj.get("kind", ""))
+    if cls is None:
+        raise ValueError(f"not a runbooks-tpu kind: {obj.get('kind')}")
+    return cls(obj)

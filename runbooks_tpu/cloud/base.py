@@ -1,0 +1,93 @@
+"""Cloud abstraction: artifact buckets, image registries, identity, mounts.
+
+Interface parity with the reference's cloud layer (reference:
+internal/cloud/cloud.go Cloud interface: Name/AutoConfigure/
+ObjectBuiltImageURL/ObjectArtifactURL/AssociatePrincipal/GetPrincipal/
+MountBucket; naming scheme internal/cloud/common.go) — with the bucket-path
+md5 scheme preserved because it is load-bearing for the "bucket as source of
+truth" restore design (reference: docs/design.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import Dict, Optional, Protocol
+
+from runbooks_tpu.api.types import Resource
+
+
+@dataclasses.dataclass
+class BucketMount:
+    bucket_subdir: str      # path inside the object's artifact prefix
+    content_subdir: str     # mount point under /content
+    read_only: bool = True
+
+
+class Cloud(Protocol):
+    name: str
+
+    def object_artifact_url(self, obj: Resource) -> str: ...
+
+    def object_built_image_url(self, obj: Resource) -> str: ...
+
+    def mount_bucket(self, pod_metadata: dict, pod_spec: dict, obj: Resource,
+                     mount: BucketMount) -> None: ...
+
+    def associate_principal(self, sa: dict) -> None: ...
+
+    def get_principal(self, sa: dict) -> tuple[str, bool]: ...
+
+
+@dataclasses.dataclass
+class CommonConfig:
+    cluster_name: str = "default"
+    artifact_bucket_url: str = ""     # e.g. gs://bucket or file:///data/bucket
+    registry_url: str = ""            # e.g. us-docker.pkg.dev/p/repo
+    principal: str = ""               # e.g. substratus@proj.iam.gserviceaccount.com
+
+    @classmethod
+    def from_env(cls) -> "CommonConfig":
+        return cls(
+            cluster_name=os.environ.get("CLUSTER_NAME", "default"),
+            artifact_bucket_url=os.environ.get("ARTIFACT_BUCKET_URL", ""),
+            registry_url=os.environ.get("REGISTRY_URL", ""),
+            principal=os.environ.get("PRINCIPAL", ""),
+        )
+
+
+def object_bucket_path(cluster: str, obj: Resource) -> str:
+    """Deterministic artifact prefix: md5 over the object's logical path, so
+    re-created clusters/objects find their prior artifacts (reference:
+    internal/cloud/common.go:45-66 and docs/design.md:80-137)."""
+    logical = (f"clusters/{cluster}/namespaces/{obj.namespace}/"
+               f"{obj.kind.lower()}s/{obj.name}")
+    return hashlib.md5(logical.encode()).hexdigest()
+
+
+def image_name(cfg: CommonConfig, obj: Resource, tag: str) -> str:
+    """{registry}/{cluster}-{kind}-{ns}-{name}:{tag} (reference:
+    internal/cloud/common.go:18-43)."""
+    return (f"{cfg.registry_url}/{cfg.cluster_name}-{obj.kind.lower()}-"
+            f"{obj.namespace}-{obj.name}:{tag}")
+
+
+def image_tag_for(obj: Resource) -> str:
+    """Tag = git ref when building from git, upload md5 when building from an
+    upload, 'latest' otherwise."""
+    git = obj.build_git
+    if git:
+        return git.get("tag") or git.get("branch") or "main"
+    upload = obj.build_upload
+    if upload and upload.get("md5checksum"):
+        return upload["md5checksum"]
+    return "latest"
+
+
+def parse_bucket_url(url: str) -> tuple[str, str]:
+    """'scheme://bucket[/path]' -> (scheme, 'bucket[/path]')."""
+    if "://" not in url:
+        raise ValueError(f"invalid bucket url {url!r}")
+    scheme, rest = url.split("://", 1)
+    return scheme, rest.rstrip("/")

@@ -1,0 +1,104 @@
+"""GCP cloud: GCS-FUSE bucket mounts + Artifact Registry + workload identity.
+
+Reference behavior mirrored (reference: internal/cloud/gcp.go): artifact
+buckets mount through the GKE GCS FUSE CSI driver (pod annotations
+``gke-gcsfuse/*`` + a csi volume), images go to Artifact Registry, and
+Kubernetes ServiceAccounts bind to the GCP principal via the
+``iam.gke.io/gcp-service-account`` annotation (the IAM policy half happens in
+the SCI service — runbooks_tpu.sci).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from runbooks_tpu.api.types import Resource
+from runbooks_tpu.cloud.base import (
+    BucketMount,
+    CommonConfig,
+    image_name,
+    image_tag_for,
+    object_bucket_path,
+    parse_bucket_url,
+)
+
+WI_ANNOTATION = "iam.gke.io/gcp-service-account"
+# The artifact FSGroup the workload containers run with so gcsfuse-written
+# files stay group-writable (reference: model_controller.go FSGroup 3003).
+ARTIFACT_FS_GROUP = 3003
+
+
+@dataclasses.dataclass
+class GCPConfig:
+    common: CommonConfig
+    project_id: str = ""
+    cluster_location: str = ""
+
+
+@dataclasses.dataclass
+class GCPCloud:
+    config: GCPConfig
+    name: str = "gcp"
+
+    # -- URLs ----------------------------------------------------------
+
+    def object_artifact_url(self, obj: Resource) -> str:
+        scheme, bucket = parse_bucket_url(
+            self.config.common.artifact_bucket_url)
+        assert scheme == "gs", f"expected gs:// bucket, got {scheme}"
+        return (f"gs://{bucket}/"
+                f"{object_bucket_path(self.config.common.cluster_name, obj)}")
+
+    def object_built_image_url(self, obj: Resource) -> str:
+        return image_name(self.config.common, obj, image_tag_for(obj))
+
+    # -- pod mutation --------------------------------------------------
+
+    def mount_bucket(self, pod_metadata: dict, pod_spec: dict, obj: Resource,
+                     mount: BucketMount) -> None:
+        annotations = pod_metadata.setdefault("annotations", {})
+        annotations["gke-gcsfuse/volumes"] = "true"
+        annotations.setdefault("gke-gcsfuse/cpu-limit", "2")
+        annotations.setdefault("gke-gcsfuse/memory-limit", "800Mi")
+        annotations.setdefault("gke-gcsfuse/ephemeral-storage-limit", "20Gi")
+
+        _, bucket = parse_bucket_url(self.config.common.artifact_bucket_url)
+        bucket_name = bucket.split("/", 1)[0]
+        prefix = object_bucket_path(self.config.common.cluster_name, obj)
+        vol_name = f"gcs-{mount.content_subdir}".replace("/", "-")
+        vols = pod_spec.setdefault("volumes", [])
+        if not any(v["name"] == vol_name for v in vols):
+            vols.append({
+                "name": vol_name,
+                "csi": {
+                    "driver": "gcsfuse.csi.storage.gke.io",
+                    "readOnly": mount.read_only,
+                    "volumeAttributes": {
+                        "bucketName": bucket_name,
+                        "mountOptions":
+                            f"implicit-dirs,uid=0,gid={ARTIFACT_FS_GROUP}",
+                    },
+                },
+            })
+        pod_spec.setdefault("securityContext", {})["fsGroup"] = \
+            ARTIFACT_FS_GROUP
+        for container in pod_spec.get("containers", []):
+            container.setdefault("volumeMounts", []).append({
+                "name": vol_name,
+                "mountPath": f"/content/{mount.content_subdir}",
+                # SubPath selects the object's prefix inside the bucket.
+                "subPath": f"{prefix}/{mount.bucket_subdir}",
+                "readOnly": mount.read_only,
+            })
+
+    # -- identity ------------------------------------------------------
+
+    def associate_principal(self, sa: dict) -> None:
+        sa.setdefault("metadata", {}).setdefault("annotations", {})[
+            WI_ANNOTATION] = self.config.common.principal
+
+    def get_principal(self, sa: dict) -> tuple[str, bool]:
+        principal = self.config.common.principal
+        bound = (sa.get("metadata", {}).get("annotations", {})
+                 .get(WI_ANNOTATION) == principal)
+        return principal, bound

@@ -1,0 +1,77 @@
+"""Local cloud: hostPath buckets + local registry — the dev/CI substitute.
+
+Plays the role of the reference's kind cloud (reference: internal/cloud/
+kind.go — hostPath /bucket with a tar:// scheme hack, registry discovered
+from the in-cluster Service): the whole operator loop (build -> store ->
+mount -> serve) runs on a laptop/CI with zero cloud dependencies. Identity
+binding is a no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from runbooks_tpu.api.types import Resource
+from runbooks_tpu.cloud.base import (
+    BucketMount,
+    CommonConfig,
+    image_name,
+    image_tag_for,
+    object_bucket_path,
+    parse_bucket_url,
+)
+
+
+@dataclasses.dataclass
+class LocalCloud:
+    config: CommonConfig
+    name: str = "local"
+
+    def __post_init__(self):
+        if not self.config.artifact_bucket_url:
+            self.config.artifact_bucket_url = "file:///bucket"
+        if not self.config.registry_url:
+            self.config.registry_url = "localhost:5000"
+
+    # -- URLs ----------------------------------------------------------
+
+    def object_artifact_url(self, obj: Resource) -> str:
+        scheme, bucket = parse_bucket_url(self.config.artifact_bucket_url)
+        return (f"{scheme}://{bucket}/"
+                f"{object_bucket_path(self.config.cluster_name, obj)}")
+
+    def object_built_image_url(self, obj: Resource) -> str:
+        return image_name(self.config, obj, image_tag_for(obj))
+
+    # -- pod mutation --------------------------------------------------
+
+    def mount_bucket(self, pod_metadata: dict, pod_spec: dict, obj: Resource,
+                     mount: BucketMount) -> None:
+        _, bucket = parse_bucket_url(self.config.artifact_bucket_url)
+        host_root = "/" + bucket.lstrip("/")
+        prefix = object_bucket_path(self.config.cluster_name, obj)
+        vol_name = f"artifacts-{mount.content_subdir}".replace("/", "-")
+        vols = pod_spec.setdefault("volumes", [])
+        if not any(v["name"] == vol_name for v in vols):
+            vols.append({
+                "name": vol_name,
+                "hostPath": {
+                    "path": f"{host_root}/{prefix}/{mount.bucket_subdir}",
+                    "type": "DirectoryOrCreate",
+                },
+            })
+        for container in pod_spec.get("containers", []):
+            mounts = container.setdefault("volumeMounts", [])
+            mounts.append({
+                "name": vol_name,
+                "mountPath": f"/content/{mount.content_subdir}",
+                "readOnly": mount.read_only,
+            })
+
+    # -- identity ------------------------------------------------------
+
+    def associate_principal(self, sa: dict) -> None:  # no-op locally
+        return None
+
+    def get_principal(self, sa: dict) -> tuple[str, bool]:
+        return "", True

@@ -1,0 +1,187 @@
+"""TPU resource mapping + multi-host pod-slice fan-out.
+
+This replaces the reference's GPU resources layer (reference:
+internal/resources/resources.go Apply + gpu_info.go nvidia.com/gpu & GKE
+accelerator node selectors) with the TPU-native equivalent, including the one
+capability the reference never had (SURVEY.md §2a): **multi-host fan-out** —
+a topology that spans hosts becomes an indexed Job (one pod per TPU VM host)
+plus a headless Service for stable DNS, with the env JAX needs to form the
+slice (`jax.distributed.initialize` coordinator at pod index 0,
+megascale-style worker ids from the completion index).
+
+Topology math (GKE conventions):
+- v5e (tpu-v5-lite-podslice, ct5lp machines): topology "AxB", 4 chips per
+  host once the slice has >= 4 chips (1x1/2x2 are single-host partial).
+- v5p (tpu-v5p-slice): topology "AxBxC", 4 chips per host.
+- v4  (tpu-v4-podslice): topology "AxBxC", 4 chips per host.
+- v6e (tpu-v6e-slice): topology "AxB", 4 chips per host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional
+
+JAX_COORDINATOR_PORT = 8476
+
+TPU_TYPES: Dict[str, Dict] = {
+    "v5e": {"accelerator": "tpu-v5-lite-podslice", "dims": 2,
+            "chips_per_host": 4},
+    "v5p": {"accelerator": "tpu-v5p-slice", "dims": 3, "chips_per_host": 4},
+    "v4": {"accelerator": "tpu-v4-podslice", "dims": 3, "chips_per_host": 4},
+    "v6e": {"accelerator": "tpu-v6e-slice", "dims": 2, "chips_per_host": 4},
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUSlice:
+    type: str               # v5e | v5p | v4 | v6e
+    topology: str           # "2x4" / "2x2x2"
+    chips: int
+    hosts: int
+    chips_per_host: int
+    accelerator: str        # GKE node-selector value
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+
+def parse_tpu(tpu: dict) -> TPUSlice:
+    """Validate + resolve a spec.resources.tpu {type, topology} block."""
+    tpu_type = tpu.get("type", "")
+    info = TPU_TYPES.get(tpu_type)
+    if info is None:
+        raise ValueError(
+            f"unknown tpu type {tpu_type!r}; known: {sorted(TPU_TYPES)}")
+    topology = tpu.get("topology", "")
+    try:
+        dims = [int(d) for d in topology.split("x")]
+    except ValueError:
+        raise ValueError(f"invalid tpu topology {topology!r}")
+    if len(dims) != info["dims"] or any(d < 1 for d in dims):
+        raise ValueError(
+            f"tpu type {tpu_type} needs a {info['dims']}-dimensional "
+            f"topology (e.g. {'2x2' if info['dims'] == 2 else '2x2x2'}), "
+            f"got {topology!r}")
+    chips = math.prod(dims)
+    chips_per_host = min(info["chips_per_host"], chips)
+    hosts = max(1, chips // info["chips_per_host"])
+    return TPUSlice(type=tpu_type, topology=topology, chips=chips,
+                    hosts=hosts, chips_per_host=chips_per_host,
+                    accelerator=info["accelerator"])
+
+
+def apply_cpu_resources(pod_spec: dict, container_name: str,
+                        resources: dict) -> None:
+    """cpu/memory/disk requests+limits on the named container (reference:
+    internal/resources/resources.go Apply)."""
+    for container in pod_spec.get("containers", []):
+        if container.get("name") != container_name:
+            continue
+        res = container.setdefault("resources", {})
+        requests = res.setdefault("requests", {})
+        limits = res.setdefault("limits", {})
+        requests["cpu"] = str(resources.get("cpu", 2))
+        requests["memory"] = f"{resources.get('memory', 10)}Gi"
+        requests["ephemeral-storage"] = f"{resources.get('disk', 10)}Gi"
+        limits["memory"] = requests["memory"]
+        limits["ephemeral-storage"] = requests["ephemeral-storage"]
+
+
+def apply_tpu_resources(pod_spec: dict, container_name: str,
+                        slice_: TPUSlice, spot: bool = False) -> None:
+    """google.com/tpu requests + topology node selectors (+ spot toleration
+    to trigger node auto-provisioning, like the reference's GKE spot flow —
+    reference: internal/resources/resources.go:52-60)."""
+    selectors = pod_spec.setdefault("nodeSelector", {})
+    selectors["cloud.google.com/gke-tpu-accelerator"] = slice_.accelerator
+    selectors["cloud.google.com/gke-tpu-topology"] = slice_.topology
+    if spot:
+        selectors["cloud.google.com/gke-spot"] = "true"
+        pod_spec.setdefault("tolerations", []).append({
+            "key": "cloud.google.com/gke-spot",
+            "operator": "Equal",
+            "value": "true",
+            "effect": "NoSchedule",
+        })
+    for container in pod_spec.get("containers", []):
+        if container.get("name") != container_name:
+            continue
+        res = container.setdefault("resources", {})
+        res.setdefault("requests", {})["google.com/tpu"] = \
+            str(slice_.chips_per_host)
+        res.setdefault("limits", {})["google.com/tpu"] = \
+            str(slice_.chips_per_host)
+
+
+def distributed_env(job_name: str, service_name: str, namespace: str,
+                    slice_: TPUSlice) -> List[dict]:
+    """Env for jax.distributed slice formation on indexed-Job pods: the
+    coordinator is pod index 0 via the headless service; worker identity
+    comes from the completion index (SURVEY.md §5.8 — the reference has no
+    trainer rendezvous at all; this is the XLA-collectives-over-ICI answer)."""
+    coordinator = (f"{job_name}-0.{service_name}.{namespace}"
+                   f".svc.cluster.local:{JAX_COORDINATOR_PORT}")
+    return [
+        {"name": "JAX_COORDINATOR_ADDRESS", "value": coordinator},
+        {"name": "JAX_NUM_PROCESSES", "value": str(slice_.hosts)},
+        {"name": "JAX_PROCESS_ID", "valueFrom": {"fieldRef": {
+            "fieldPath":
+                "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+        }}},
+        {"name": "TPU_WORKER_ID", "valueFrom": {"fieldRef": {
+            "fieldPath":
+                "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+        }}},
+        {"name": "TPU_WORKER_HOSTNAMES", "value": ",".join(
+            f"{job_name}-{i}.{service_name}.{namespace}.svc.cluster.local"
+            for i in range(slice_.hosts))},
+    ]
+
+
+def headless_service(job_name: str, namespace: str) -> dict:
+    """Stable per-pod DNS for the slice (clusterIP: None + job-name
+    selector)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": job_name, "namespace": namespace},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"job-name": job_name},
+            "ports": [{"name": "jax-coordinator",
+                       "port": JAX_COORDINATOR_PORT}],
+        },
+    }
+
+
+def fan_out_job(job: dict, slice_: TPUSlice) -> Optional[dict]:
+    """Turn a single-pod Job into a multi-host indexed Job; returns the
+    headless Service to create alongside (None when single-host).
+
+    All-hosts-or-nothing: parallelism == completions == hosts, Indexed
+    completion mode, subdomain for stable DNS, and the jax.distributed env
+    on every container.
+    """
+    if not slice_.multi_host:
+        return None
+    name = job["metadata"]["name"]
+    namespace = job["metadata"].get("namespace", "default")
+    spec = job["spec"]
+    spec["completions"] = slice_.hosts
+    spec["parallelism"] = slice_.hosts
+    spec["completionMode"] = "Indexed"
+    pod_spec = spec["template"]["spec"]
+    pod_spec["subdomain"] = name
+    # One host dies => whole slice restarts (slice-consistent restart).
+    spec["backoffLimit"] = spec.get("backoffLimit", 0)
+    pod_spec.setdefault("restartPolicy", "Never")
+    env = distributed_env(name, name, namespace, slice_)
+    for container in pod_spec.get("containers", []):
+        container.setdefault("env", [])
+        existing = {e["name"] for e in container["env"]}
+        container["env"].extend(
+            e for e in env if e["name"] not in existing)
+    return headless_service(name, namespace)

@@ -1,0 +1,171 @@
+"""Shared reconciler helpers: job lifecycle, env resolution, SA plumbing,
+params ConfigMaps.
+
+Reference analogs: internal/controller/utils.go (reconcileJob/jobResult/
+isPodReady/resolveEnv), params_reconciler.go, service_accounts_controller.go.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional, Tuple
+
+from runbooks_tpu.api import conditions as cond
+from runbooks_tpu.api.types import Resource
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.utils.contract import params_to_env
+
+FIELD_MANAGER = "runbooks-tpu-controller"
+
+# Well-known workload ServiceAccounts (reference:
+# service_accounts_controller.go:16-22).
+SA_CONTAINER_BUILDER = "container-builder"
+SA_MODELLER = "modeller"
+SA_MODEL_SERVER = "model-server"
+SA_NOTEBOOK = "notebook"
+SA_DATA_LOADER = "data-loader"
+
+_SECRET_RE = re.compile(
+    r"^\s*\$\{\{\s*secrets\.([A-Za-z0-9-_.]+)\.([A-Za-z0-9-_.]+)\s*\}\}\s*$")
+
+
+def resolve_env(env: Dict[str, str]) -> List[dict]:
+    """NAME: value map -> container env list; values of the form
+    ``${{ secrets.<name>.<key> }}`` become secretKeyRef (reference:
+    internal/controller/utils.go:67-93)."""
+    out = []
+    for name, value in sorted(env.items()):
+        m = _SECRET_RE.match(str(value))
+        if m:
+            out.append({"name": name, "valueFrom": {"secretKeyRef": {
+                "name": m.group(1), "key": m.group(2)}}})
+        else:
+            out.append({"name": name, "value": str(value)})
+    return out
+
+
+def params_env(params: dict) -> List[dict]:
+    return [{"name": k, "value": v}
+            for k, v in sorted(params_to_env(params).items())]
+
+
+# ---------------------------------------------------------------------------
+# Jobs
+# ---------------------------------------------------------------------------
+
+def job_status(job: Optional[dict]) -> Tuple[bool, bool]:
+    """(complete, failed) from Job conditions."""
+    if not job:
+        return False, False
+    for c in ko.deep_get(job, "status", "conditions", default=[]) or []:
+        if c.get("type") == "Complete" and c.get("status") == "True":
+            return True, False
+        if c.get("type") == "Failed" and c.get("status") == "True":
+            return False, True
+    return False, False
+
+
+def reconcile_job(client, job: dict) -> Tuple[bool, bool]:
+    """Create-if-absent then report (complete, failed) (reference:
+    utils.go:23-35)."""
+    ns, name = ko.namespace(job), ko.name(job)
+    existing = client.get("batch/v1", "Job", ns, name)
+    if existing is None:
+        client.create(job)
+        return False, False
+    return job_status(existing)
+
+
+def is_pod_ready(pod: Optional[dict]) -> bool:
+    if not pod:
+        return False
+    for c in ko.deep_get(pod, "status", "conditions", default=[]) or []:
+        if c.get("type") == "Ready" and c.get("status") == "True":
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Params ConfigMap (reference: params_reconciler.go)
+# ---------------------------------------------------------------------------
+
+def params_configmap_name(obj: Resource) -> str:
+    return f"{obj.name}-{obj.kind.lower()}-params"
+
+
+def reconcile_params_configmap(client, obj: Resource) -> None:
+    cm = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": params_configmap_name(obj),
+                     "namespace": obj.namespace},
+        "data": {"params.json": json.dumps(obj.params, sort_keys=True)},
+    }
+    ko.set_owner(cm, obj.obj)
+    client.apply(cm, FIELD_MANAGER)
+
+
+def mount_params(pod_spec: dict, container_name: str, obj: Resource) -> None:
+    """Mount params.json at /content/params.json via subPath + inject the
+    PARAM_* env (the reference documents the env half in its contract but
+    only implements the file mount — here both are real; reference:
+    params_reconciler.go:78-104, docs/container-contract.md)."""
+    vols = pod_spec.setdefault("volumes", [])
+    if not any(v.get("name") == "params" for v in vols):
+        vols.append({"name": "params", "configMap": {
+            "name": params_configmap_name(obj)}})
+    for container in pod_spec.get("containers", []):
+        if container.get("name") != container_name:
+            continue
+        container.setdefault("volumeMounts", []).append({
+            "name": "params",
+            "mountPath": "/content/params.json",
+            "subPath": "params.json",
+        })
+        container.setdefault("env", []).extend(params_env(obj.params))
+
+
+# ---------------------------------------------------------------------------
+# ServiceAccounts (reference: service_accounts_controller.go)
+# ---------------------------------------------------------------------------
+
+def reconcile_service_account(client, cloud, sci, name: str,
+                              namespace: str) -> None:
+    sa = client.get("v1", "ServiceAccount", namespace, name)
+    if sa is None:
+        sa = {"apiVersion": "v1", "kind": "ServiceAccount",
+              "metadata": {"name": name, "namespace": namespace}}
+    principal, bound = cloud.get_principal(sa)
+    cloud.associate_principal(sa)
+    client.apply(sa, FIELD_MANAGER)
+    if principal and not bound:
+        sci.bind_identity(principal=principal, ksa=name, namespace=namespace)
+
+
+# ---------------------------------------------------------------------------
+# Dependency gates
+# ---------------------------------------------------------------------------
+
+def gate_dependency(ctx, obj: Resource, dep_kind: str, dep_name: str,
+                    not_found_reason: str, not_ready_reason: str,
+                    gate_condition: str = cond.COMPLETE,
+                    ) -> Tuple[Optional[Resource], bool]:
+    """Fetch a dependency and set gate_condition=False when it is missing or
+    not ready (Servers gate via Serving, Jobs/Notebooks via Complete).
+    Returns (dep, ok)."""
+    from runbooks_tpu.api.types import API_VERSION, KIND_TO_CLASS
+
+    raw = ctx.client.get(API_VERSION, dep_kind, obj.namespace, dep_name)
+    if raw is None:
+        obj.set_condition(gate_condition, False, not_found_reason,
+                          f"{dep_kind} {dep_name!r} not found")
+        ctx.client.update_status(obj.obj)
+        return None, False
+    dep = KIND_TO_CLASS[dep_kind](raw)
+    if not dep.ready:
+        obj.set_condition(gate_condition, False, not_ready_reason,
+                          f"{dep_kind} {dep_name!r} not ready")
+        ctx.client.update_status(obj.obj)
+        return dep, False
+    return dep, True

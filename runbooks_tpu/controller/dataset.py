@@ -1,0 +1,96 @@
+"""Dataset reconciler: the data-loader Job (reference:
+internal/controller/dataset_controller.go — {name}-data-loader Job with
+backoffLimit 2 and RW artifact mount)."""
+
+from __future__ import annotations
+
+from runbooks_tpu.api import conditions as cond
+from runbooks_tpu.api.types import Dataset
+from runbooks_tpu.cloud.base import BucketMount
+from runbooks_tpu.controller.common import (
+    SA_DATA_LOADER,
+    job_status,
+    mount_params,
+    reconcile_params_configmap,
+    reconcile_service_account,
+    resolve_env,
+)
+from runbooks_tpu.controller.manager import Ctx, Result
+from runbooks_tpu.cloud.resources import apply_cpu_resources
+from runbooks_tpu.k8s import objects as ko
+
+
+class DatasetReconciler:
+    kind = "Dataset"
+
+    def reconcile(self, ctx: Ctx, raw: dict) -> Result:
+        ds = Dataset(raw)
+        if not ds.image:
+            return Result(requeue_after=1.0)
+
+        reconcile_params_configmap(ctx.client, ds)
+        if ds.artifacts_url != ctx.cloud.object_artifact_url(ds):
+            ds.set_artifacts_url(ctx.cloud.object_artifact_url(ds))
+            ctx.client.update_status(ds.obj)
+        reconcile_service_account(ctx.client, ctx.cloud, ctx.sci,
+                                  SA_DATA_LOADER, ds.namespace)
+
+        job_name = f"{ds.name}-data-loader"
+        existing = ctx.client.get("batch/v1", "Job", ds.namespace, job_name)
+        if existing is None:
+            ctx.client.create(self._loader_job(ctx, ds, job_name))
+            ds.set_condition(cond.COMPLETE, False, cond.REASON_JOB_RUNNING)
+            ctx.client.update_status(ds.obj)
+            return Result(requeue_after=2.0)
+
+        complete, failed = job_status(existing)
+        if failed:
+            ds.set_condition(cond.COMPLETE, False, cond.REASON_JOB_FAILED,
+                             f"job {job_name} failed")
+            ds.set_ready(False)
+            ctx.client.update_status(ds.obj)
+            return Result()
+        if not complete:
+            return Result(requeue_after=2.0)
+
+        changed = ds.set_condition(cond.COMPLETE, True,
+                                   cond.REASON_JOB_COMPLETE)
+        if not ds.ready:
+            ds.set_ready(True)
+            changed = True
+        if changed:
+            ctx.client.update_status(ds.obj)
+        return Result()
+
+    def _loader_job(self, ctx: Ctx, ds: Dataset, job_name: str) -> dict:
+        container = {
+            "name": "loader",
+            "image": ds.image,
+            "env": resolve_env(ds.env),
+        }
+        if ds.command:
+            container["command"] = list(ds.command)
+        pod_spec = {
+            "serviceAccountName": SA_DATA_LOADER,
+            "restartPolicy": "Never",
+            "securityContext": {"fsGroup": 3003},
+            "containers": [container],
+        }
+        pod_meta = {"labels": {"dataset": ds.name, "role": "run"}}
+        ctx.cloud.mount_bucket(pod_meta, pod_spec, ds,
+                               BucketMount("artifacts", "artifacts",
+                                           read_only=False))
+        mount_params(pod_spec, "loader", ds)
+        apply_cpu_resources(pod_spec, "loader", ds.resources)
+        job = {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"name": job_name, "namespace": ds.namespace,
+                         "labels": {"dataset": ds.name, "role": "run"}},
+            "spec": {
+                "backoffLimit": 2,
+                "template": {"metadata": pod_meta, "spec": pod_spec},
+            },
+        }
+        ko.set_owner(job, ds.obj)
+        return job

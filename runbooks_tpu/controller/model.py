@@ -1,0 +1,158 @@
+"""Model reconciler: builds the modeller Job (train/import), TPU-aware.
+
+Reference behavior mirrored (reference: internal/controller/
+model_controller.go): gate on image built (:54-57), params ConfigMap,
+status.artifacts.url (:77), modeller SA (:83-90), base-model/dataset
+readiness gates with conditions (:92-172), modeller Job with artifact RW +
+dataset RO /content/data + base model RO /content/model mounts (:286-395),
+backoff policy that retries only cheap import jobs (:294-303). TPU-first
+additions: resources.tpu -> google.com/tpu + topology selectors, and
+multi-host pod-slice fan-out with jax.distributed env (SURVEY.md §7 M4 —
+the reference is single-pod only).
+"""
+
+from __future__ import annotations
+
+from runbooks_tpu.api import conditions as cond
+from runbooks_tpu.api.types import Model
+from runbooks_tpu.cloud.base import BucketMount
+from runbooks_tpu.cloud.resources import (
+    apply_cpu_resources,
+    apply_tpu_resources,
+    fan_out_job,
+    parse_tpu,
+)
+from runbooks_tpu.controller.common import (
+    SA_MODELLER,
+    job_status,
+    mount_params,
+    reconcile_params_configmap,
+    reconcile_service_account,
+    resolve_env,
+)
+from runbooks_tpu.controller.manager import Ctx, Result
+from runbooks_tpu.k8s import objects as ko
+
+
+class ModelReconciler:
+    kind = "Model"
+
+    def reconcile(self, ctx: Ctx, raw: dict) -> Result:
+        model = Model(raw)
+
+        # Image gate: either preset or produced by the build reconciler.
+        if not model.image:
+            return Result(requeue_after=1.0)
+
+        reconcile_params_configmap(ctx.client, model)
+
+        if model.artifacts_url != ctx.cloud.object_artifact_url(model):
+            model.set_artifacts_url(ctx.cloud.object_artifact_url(model))
+            ctx.client.update_status(model.obj)
+
+        reconcile_service_account(ctx.client, ctx.cloud, ctx.sci,
+                                  SA_MODELLER, model.namespace)
+
+        # Dependency gates.
+        from runbooks_tpu.controller.common import gate_dependency
+
+        base = dataset = None
+        if model.base_model_ref:
+            base, ok = gate_dependency(
+                ctx, model, "Model", model.base_model_ref,
+                cond.REASON_BASEMODEL_NOT_FOUND,
+                cond.REASON_BASEMODEL_NOT_READY)
+            if not ok:
+                return Result(requeue_after=2.0)
+        if model.dataset_ref:
+            dataset, ok = gate_dependency(
+                ctx, model, "Dataset", model.dataset_ref,
+                cond.REASON_DATASET_NOT_FOUND, cond.REASON_DATASET_NOT_READY)
+            if not ok:
+                return Result(requeue_after=2.0)
+
+        job_name = f"{model.name}-modeller"
+        existing = ctx.client.get("batch/v1", "Job", model.namespace,
+                                  job_name)
+        if existing is None:
+            job, svc = self._modeller_job(ctx, model, base, dataset, job_name)
+            if svc is not None:
+                if ctx.client.get("v1", "Service", model.namespace,
+                                  ko.name(svc)) is None:
+                    ko.set_owner(svc, model.obj)
+                    ctx.client.create(svc)
+            ctx.client.create(job)
+            model.set_condition(cond.COMPLETE, False, cond.REASON_JOB_RUNNING)
+            ctx.client.update_status(model.obj)
+            return Result(requeue_after=2.0)
+
+        complete, failed = job_status(existing)
+        if failed:
+            model.set_condition(cond.COMPLETE, False, cond.REASON_JOB_FAILED,
+                                f"job {job_name} failed")
+            model.set_ready(False)
+            ctx.client.update_status(model.obj)
+            return Result()
+        if not complete:
+            return Result(requeue_after=2.0)
+
+        changed = model.set_condition(cond.COMPLETE, True,
+                                      cond.REASON_JOB_COMPLETE)
+        if not model.ready:
+            model.set_ready(True)
+            changed = True
+        if changed:
+            ctx.client.update_status(model.obj)
+        return Result()
+
+    # ------------------------------------------------------------------
+
+    def _modeller_job(self, ctx: Ctx, model: Model, base, dataset,
+                      job_name: str):
+        tpu = parse_tpu(model.tpu) if model.tpu else None
+        container = {
+            "name": "model",
+            "image": model.image,
+            "env": resolve_env(model.env),
+        }
+        if model.command:
+            container["command"] = list(model.command)
+        pod_spec = {
+            "serviceAccountName": SA_MODELLER,
+            "restartPolicy": "Never",
+            "securityContext": {"fsGroup": 3003},
+            "containers": [container],
+        }
+        pod_meta = {"labels": {"model": model.name, "role": "run"}}
+
+        ctx.cloud.mount_bucket(pod_meta, pod_spec, model,
+                               BucketMount("artifacts", "artifacts",
+                                           read_only=False))
+        if dataset is not None:
+            ctx.cloud.mount_bucket(pod_meta, pod_spec, dataset,
+                                   BucketMount("artifacts", "data"))
+        if base is not None:
+            ctx.cloud.mount_bucket(pod_meta, pod_spec, base,
+                                   BucketMount("artifacts", "model"))
+        mount_params(pod_spec, "model", model)
+        apply_cpu_resources(pod_spec, "model", model.resources)
+        if tpu is not None:
+            apply_tpu_resources(pod_spec, "model", tpu,
+                                spot=model.spec.get("resources", {})
+                                .get("spot", False))
+
+        job = {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"name": job_name, "namespace": model.namespace,
+                         "labels": {"model": model.name, "role": "run"}},
+            "spec": {
+                # Expensive accelerator jobs do not blind-retry; cheap CPU
+                # import jobs get a few attempts (reference :294-303).
+                "backoffLimit": 0 if tpu is not None else 3,
+                "template": {"metadata": pod_meta, "spec": pod_spec},
+            },
+        }
+        ko.set_owner(job, model.obj)
+        svc = fan_out_job(job, tpu) if tpu is not None else None
+        return job, svc

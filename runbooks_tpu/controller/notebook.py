@@ -1,0 +1,161 @@
+"""Notebook reconciler: a suspendable Jupyter workspace Pod.
+
+Reference behavior mirrored (reference: internal/controller/
+notebook_controller.go): suspend -> delete Pod + Suspended condition
+(:134-155), model/dataset gates (:169-251), {name}-notebook Pod with default
+jupyter command, port 8888, probe /api (:312-454), delete-and-recreate on
+immutable spec drift (:266-281), model RO / dataset RO / own artifacts RW
+mounts (:408-442).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from runbooks_tpu.api import conditions as cond
+from runbooks_tpu.api.types import Notebook
+from runbooks_tpu.cloud.base import BucketMount
+from runbooks_tpu.cloud.resources import (
+    apply_cpu_resources,
+    apply_tpu_resources,
+    parse_tpu,
+)
+from runbooks_tpu.controller.common import (
+    SA_NOTEBOOK,
+    gate_dependency,
+    is_pod_ready,
+    mount_params,
+    reconcile_params_configmap,
+    reconcile_service_account,
+    resolve_env,
+)
+from runbooks_tpu.controller.manager import Ctx, Result
+from runbooks_tpu.k8s import objects as ko
+
+NOTEBOOK_PORT = 8888
+SPEC_HASH_ANNOTATION = "runbooks-tpu.dev/spec-hash"
+DEFAULT_COMMAND = ["jupyter", "lab", "--allow-root", "--ip=0.0.0.0",
+                   "--NotebookApp.token=$(NOTEBOOK_TOKEN)"]
+
+
+class NotebookReconciler:
+    kind = "Notebook"
+
+    def reconcile(self, ctx: Ctx, raw: dict) -> Result:
+        nb = Notebook(raw)
+        pod_name = f"{nb.name}-notebook"
+
+        if nb.suspended:
+            ctx.client.delete("v1", "Pod", nb.namespace, pod_name)
+            changed = nb.set_condition(cond.SUSPENDED, True,
+                                       cond.REASON_SUSPENDED)
+            if nb.ready:
+                nb.set_ready(False)
+                changed = True
+            if changed:
+                ctx.client.update_status(nb.obj)
+            return Result()
+        else:
+            nb.set_condition(cond.SUSPENDED, False, "Active")
+
+        if not nb.image:
+            return Result(requeue_after=1.0)
+        reconcile_params_configmap(ctx.client, nb)
+
+        model = dataset = None
+        if nb.model_ref:
+            model, ok = gate_dependency(
+                ctx, nb, "Model", nb.model_ref,
+                cond.REASON_MODEL_NOT_FOUND, cond.REASON_MODEL_NOT_READY)
+            if not ok:
+                return Result(requeue_after=2.0)
+        if nb.dataset_ref:
+            dataset, ok = gate_dependency(
+                ctx, nb, "Dataset", nb.dataset_ref,
+                cond.REASON_DATASET_NOT_FOUND, cond.REASON_DATASET_NOT_READY)
+            if not ok:
+                return Result(requeue_after=2.0)
+
+        reconcile_service_account(ctx.client, ctx.cloud, ctx.sci,
+                                  SA_NOTEBOOK, nb.namespace)
+
+        pod = self._pod(ctx, nb, model, dataset, pod_name)
+        spec_hash = hashlib.md5(
+            json.dumps(pod["spec"], sort_keys=True).encode()).hexdigest()
+        ko.set_annotation(pod, SPEC_HASH_ANNOTATION, spec_hash)
+
+        existing = ctx.client.get("v1", "Pod", nb.namespace, pod_name)
+        if existing is not None and \
+                ko.annotations(existing).get(SPEC_HASH_ANNOTATION) != spec_hash:
+            # Pods are immutable: drift means delete-and-recreate (:266-281).
+            ctx.client.delete("v1", "Pod", nb.namespace, pod_name)
+            existing = None
+        if existing is None:
+            ctx.client.create(pod)
+            nb.set_condition(cond.COMPLETE, False, cond.REASON_POD_NOT_READY)
+            nb.set_ready(False)
+            ctx.client.update_status(nb.obj)
+            return Result(requeue_after=2.0)
+
+        ready = is_pod_ready(existing)
+        changed = nb.set_condition(
+            cond.COMPLETE, ready,
+            cond.REASON_POD_READY if ready else cond.REASON_POD_NOT_READY)
+        if nb.ready != ready:
+            nb.set_ready(ready)
+            changed = True
+        if changed:
+            ctx.client.update_status(nb.obj)
+        return Result() if ready else Result(requeue_after=2.0)
+
+    # ------------------------------------------------------------------
+
+    def _pod(self, ctx: Ctx, nb: Notebook, model, dataset,
+             pod_name: str) -> dict:
+        tpu = parse_tpu(nb.tpu) if nb.tpu else None
+        env = dict(nb.env)
+        env.setdefault("NOTEBOOK_TOKEN", "default")
+        container = {
+            "name": "notebook",
+            "image": nb.image,
+            "command": list(nb.command) if nb.command else DEFAULT_COMMAND,
+            "env": resolve_env(env),
+            "ports": [{"name": "notebook", "containerPort": NOTEBOOK_PORT}],
+            "readinessProbe": {
+                "httpGet": {"path": "/api", "port": NOTEBOOK_PORT},
+                "periodSeconds": 5,
+            },
+        }
+        pod_spec = {
+            "serviceAccountName": SA_NOTEBOOK,
+            "securityContext": {"fsGroup": 3003},
+            "containers": [container],
+        }
+        pod_meta = {"labels": {"notebook": nb.name, "role": "run"}}
+        ctx.cloud.mount_bucket(pod_meta, pod_spec, nb,
+                               BucketMount("artifacts", "artifacts",
+                                           read_only=False))
+        if model is not None:
+            ctx.cloud.mount_bucket(pod_meta, pod_spec, model,
+                                   BucketMount("artifacts", "model"))
+        if dataset is not None:
+            ctx.cloud.mount_bucket(pod_meta, pod_spec, dataset,
+                                   BucketMount("artifacts", "data"))
+        mount_params(pod_spec, "notebook", nb)
+        apply_cpu_resources(pod_spec, "notebook", nb.resources)
+        if tpu is not None:
+            apply_tpu_resources(pod_spec, "notebook", tpu)
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": pod_name, "namespace": nb.namespace,
+                         "labels": {"notebook": nb.name, "role": "run"}},
+            "spec": pod_spec,
+        }
+        pod["metadata"].update(pod_meta.get("metadata", {}))
+        pod["metadata"]["labels"].update(pod_meta.get("labels", {}))
+        if pod_meta.get("annotations"):
+            pod["metadata"]["annotations"] = dict(pod_meta["annotations"])
+        ko.set_owner(pod, nb.obj)
+        return pod

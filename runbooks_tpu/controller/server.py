@@ -1,0 +1,145 @@
+"""Server reconciler: Service + Deployment for a ready Model.
+
+Reference behavior mirrored (reference: internal/controller/
+server_controller.go): model readiness gate with conditions (:210-246),
+model-server SA (:251-258), Service port 80 -> "http-serve" 8080 (:307-335),
+Deployment with readiness probe GET / on 8080 and the model mounted RO at
+/content/model (:114-205), Serving condition from ReadyReplicas (:280-296).
+TPU-first: resources.tpu schedules the server pods onto TPU slices
+(single-host topologies; inference fan-out across hosts arrives with the
+multi-host serving engine).
+"""
+
+from __future__ import annotations
+
+from runbooks_tpu.api import conditions as cond
+from runbooks_tpu.api.types import Server
+from runbooks_tpu.cloud.base import BucketMount
+from runbooks_tpu.cloud.resources import (
+    apply_cpu_resources,
+    apply_tpu_resources,
+    parse_tpu,
+)
+from runbooks_tpu.controller.common import (
+    FIELD_MANAGER,
+    SA_MODEL_SERVER,
+    gate_dependency,
+    mount_params,
+    reconcile_params_configmap,
+    reconcile_service_account,
+    resolve_env,
+)
+from runbooks_tpu.controller.manager import Ctx, Result
+from runbooks_tpu.k8s import objects as ko
+
+SERVE_PORT = 8080
+
+
+class ServerReconciler:
+    kind = "Server"
+
+    def reconcile(self, ctx: Ctx, raw: dict) -> Result:
+        server = Server(raw)
+        if not server.image:
+            return Result(requeue_after=1.0)
+        reconcile_params_configmap(ctx.client, server)
+
+        if not server.model_ref:
+            server.set_condition(cond.SERVING, False,
+                                 cond.REASON_MODEL_NOT_FOUND,
+                                 "spec.model is required")
+            ctx.client.update_status(server.obj)
+            return Result()
+        model, ok = gate_dependency(
+            ctx, server, "Model", server.model_ref,
+            cond.REASON_MODEL_NOT_FOUND, cond.REASON_MODEL_NOT_READY,
+            gate_condition=cond.SERVING)
+        if not ok:
+            return Result(requeue_after=2.0)
+
+        reconcile_service_account(ctx.client, ctx.cloud, ctx.sci,
+                                  SA_MODEL_SERVER, server.namespace)
+
+        svc = self._service(server)
+        ko.set_owner(svc, server.obj)
+        ctx.client.apply(svc, FIELD_MANAGER)
+
+        dep = self._deployment(ctx, server, model)
+        ko.set_owner(dep, server.obj)
+        ctx.client.apply(dep, FIELD_MANAGER)
+
+        current = ctx.client.get("apps/v1", "Deployment", server.namespace,
+                                 server.name)
+        ready_replicas = ko.deep_get(current, "status", "readyReplicas",
+                                     default=0) or 0
+        replicas = server.spec.get("replicas", 1)
+        serving = ready_replicas >= max(1, replicas)
+        changed = server.set_condition(
+            cond.SERVING, serving,
+            cond.REASON_DEPLOYMENT_READY if serving
+            else cond.REASON_DEPLOYMENT_NOT_READY,
+            f"{ready_replicas}/{replicas} replicas ready")
+        if server.ready != serving:
+            server.set_ready(serving)
+            changed = True
+        if changed:
+            ctx.client.update_status(server.obj)
+        return Result() if serving else Result(requeue_after=2.0)
+
+    # ------------------------------------------------------------------
+
+    def _service(self, server: Server) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": server.name, "namespace": server.namespace},
+            "spec": {
+                "selector": {"server": server.name, "role": "run"},
+                "ports": [{"name": "http-serve", "port": 80,
+                           "targetPort": SERVE_PORT, "protocol": "TCP"}],
+            },
+        }
+
+    def _deployment(self, ctx: Ctx, server: Server, model) -> dict:
+        tpu = parse_tpu(server.tpu) if server.tpu else None
+        container = {
+            "name": "serve",
+            "image": server.image,
+            "env": resolve_env(server.env),
+            "ports": [{"name": "http-serve",
+                       "containerPort": SERVE_PORT}],
+            "readinessProbe": {
+                "httpGet": {"path": "/", "port": SERVE_PORT},
+                "periodSeconds": 5,
+                "initialDelaySeconds": 5,
+            },
+            "startupProbe": {
+                "httpGet": {"path": "/", "port": SERVE_PORT},
+                "failureThreshold": 60,
+                "periodSeconds": 10,
+            },
+        }
+        if server.command:
+            container["command"] = list(server.command)
+        pod_spec = {
+            "serviceAccountName": SA_MODEL_SERVER,
+            "containers": [container],
+        }
+        pod_meta = {"labels": {"server": server.name, "role": "run"}}
+        ctx.cloud.mount_bucket(pod_meta, pod_spec, model,
+                               BucketMount("artifacts", "model"))
+        mount_params(pod_spec, "serve", server)
+        apply_cpu_resources(pod_spec, "serve", server.resources)
+        if tpu is not None:
+            apply_tpu_resources(pod_spec, "serve", tpu)
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": server.name, "namespace": server.namespace},
+            "spec": {
+                "replicas": server.spec.get("replicas", 1),
+                "selector": {"matchLabels": {"server": server.name,
+                                             "role": "run"}},
+                "template": {"metadata": pod_meta, "spec": pod_spec},
+            },
+        }

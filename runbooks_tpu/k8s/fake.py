@@ -1,0 +1,239 @@
+"""In-memory fake Kubernetes API — the envtest analog.
+
+The reference tests its reconcilers against a real envtest apiserver with no
+kubelet, manually patching Job/Pod status (reference: internal/controller/
+main_test.go fakeJobComplete/fakePodReady). This fake plays the same role
+with zero external processes: it implements the same ``ApiClient`` interface
+the real REST client exposes, with resourceVersion/uid/generation
+bookkeeping, label-selector lists, server-side-apply-style merges, and watch
+streams — enough fidelity for every controller test to run hermetically.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from runbooks_tpu.k8s import objects as ko
+
+Obj = Dict[str, Any]
+Key = Tuple[str, str, str, str]  # api_version, kind, namespace, name
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+def _key(api_version: str, kind: str, namespace: str, name: str) -> Key:
+    return (api_version, kind, namespace, name)
+
+
+def _matches_selector(obj: Obj, selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    lbls = ko.labels(obj)
+    return all(lbls.get(k) == v for k, v in selector.items())
+
+
+def _merge(dst: Any, src: Any) -> Any:
+    """Server-side-apply-flavored merge: dicts merge recursively, None
+    deletes a key, everything else replaces."""
+    if isinstance(dst, dict) and isinstance(src, dict):
+        out = dict(dst)
+        for k, v in src.items():
+            if v is None:
+                out.pop(k, None)
+            else:
+                out[k] = _merge(out.get(k), v)
+        return out
+    return src
+
+
+class Subscription:
+    """A watch stream: iterate or poll events ("ADDED"/"MODIFIED"/"DELETED")."""
+
+    def __init__(self):
+        self.q: "queue.Queue[Tuple[str, Obj]]" = queue.Queue()
+
+    def put(self, event: str, obj: Obj) -> None:
+        self.q.put((event, ko.clone(obj)))
+
+    def poll(self, timeout: float = 0.0):
+        try:
+            return self.q.get(timeout=timeout) if timeout else self.q.get_nowait()
+        except queue.Empty:
+            return None
+
+
+class FakeCluster:
+    """Thread-safe in-memory object store implementing the ApiClient shape."""
+
+    def __init__(self):
+        self._objs: Dict[Key, Obj] = {}
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._uid = itertools.count(1)
+        self._subs: List[Tuple[Optional[str], Optional[str], Subscription]] = []
+
+    # -- reads ---------------------------------------------------------
+
+    def get(self, api_version: str, kind: str, namespace: str,
+            name: str) -> Optional[Obj]:
+        with self._lock:
+            obj = self._objs.get(_key(api_version, kind, namespace, name))
+            return ko.clone(obj) if obj else None
+
+    def list(self, api_version: str, kind: str,
+             namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Obj]:
+        with self._lock:
+            out = []
+            for (av, k, ns, _), obj in self._objs.items():
+                if av == api_version and k == kind and \
+                        (namespace is None or ns == namespace) and \
+                        _matches_selector(obj, label_selector):
+                    out.append(ko.clone(obj))
+            return out
+
+    # -- writes --------------------------------------------------------
+
+    def create(self, obj: Obj) -> Obj:
+        with self._lock:
+            k = (ko.api_version(obj), ko.kind(obj), ko.namespace(obj),
+                 ko.name(obj))
+            if k in self._objs:
+                raise AlreadyExists(f"{k} already exists")
+            stored = ko.clone(obj)
+            meta = stored.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            meta["uid"] = f"uid-{next(self._uid)}"
+            meta["generation"] = 1
+            meta["resourceVersion"] = str(next(self._rv))
+            self._objs[k] = stored
+            self._notify("ADDED", stored)
+            return ko.clone(stored)
+
+    def update(self, obj: Obj) -> Obj:
+        """Full replace of spec/metadata (status preserved)."""
+        with self._lock:
+            k = (ko.api_version(obj), ko.kind(obj), ko.namespace(obj),
+                 ko.name(obj))
+            cur = self._objs.get(k)
+            if cur is None:
+                raise NotFound(str(k))
+            rv = ko.deep_get(obj, "metadata", "resourceVersion")
+            if rv is not None and rv != cur["metadata"]["resourceVersion"]:
+                raise Conflict(f"resourceVersion mismatch for {k}")
+            stored = ko.clone(obj)
+            stored.setdefault("metadata", {})
+            stored["metadata"]["uid"] = cur["metadata"]["uid"]
+            if stored.get("spec") != cur.get("spec"):
+                stored["metadata"]["generation"] = \
+                    cur["metadata"].get("generation", 1) + 1
+            else:
+                stored["metadata"]["generation"] = \
+                    cur["metadata"].get("generation", 1)
+            stored["metadata"]["resourceVersion"] = str(next(self._rv))
+            stored.setdefault("status", cur.get("status", {}))
+            self._objs[k] = stored
+            self._notify("MODIFIED", stored)
+            return ko.clone(stored)
+
+    def apply(self, patch: Obj, field_manager: str = "") -> Obj:
+        """Server-side-apply style create-or-merge."""
+        with self._lock:
+            k = (ko.api_version(patch), ko.kind(patch), ko.namespace(patch),
+                 ko.name(patch))
+            cur = self._objs.get(k)
+            if cur is None:
+                return self.create(patch)
+            merged = _merge(cur, {kk: vv for kk, vv in patch.items()
+                                  if kk != "status"})
+            merged["metadata"]["uid"] = cur["metadata"]["uid"]
+            merged["metadata"]["resourceVersion"] = \
+                cur["metadata"]["resourceVersion"]
+            if merged.get("spec") != cur.get("spec"):
+                merged["metadata"]["generation"] = \
+                    cur["metadata"].get("generation", 1) + 1
+            merged["metadata"]["resourceVersion"] = str(next(self._rv))
+            self._objs[k] = merged
+            self._notify("MODIFIED", merged)
+            return ko.clone(merged)
+
+    def update_status(self, obj: Obj) -> Obj:
+        with self._lock:
+            k = (ko.api_version(obj), ko.kind(obj), ko.namespace(obj),
+                 ko.name(obj))
+            cur = self._objs.get(k)
+            if cur is None:
+                raise NotFound(str(k))
+            cur["status"] = ko.clone(obj.get("status", {}))
+            cur["metadata"]["resourceVersion"] = str(next(self._rv))
+            self._notify("MODIFIED", cur)
+            return ko.clone(cur)
+
+    def delete(self, api_version: str, kind: str, namespace: str,
+               name: str) -> bool:
+        with self._lock:
+            obj = self._objs.pop(_key(api_version, kind, namespace, name),
+                                 None)
+            if obj is not None:
+                self._notify("DELETED", obj)
+            return obj is not None
+
+    # -- watches -------------------------------------------------------
+
+    def watch(self, api_version: Optional[str] = None,
+              kind: Optional[str] = None) -> Subscription:
+        sub = Subscription()
+        with self._lock:
+            self._subs.append((api_version, kind, sub))
+            # Prime with existing objects (watch-from-now + initial list).
+            for (av, k, _, _), obj in self._objs.items():
+                if (api_version is None or av == api_version) and \
+                        (kind is None or k == kind):
+                    sub.put("ADDED", obj)
+        return sub
+
+    def _notify(self, event: str, obj: Obj) -> None:
+        for av, k, sub in self._subs:
+            if (av is None or av == ko.api_version(obj)) and \
+                    (k is None or k == ko.kind(obj)):
+                sub.put(event, obj)
+
+    # -- test helpers (fakeJobComplete / fakePodReady analogs) ---------
+
+    def mark_job_complete(self, namespace: str, name: str,
+                          failed: bool = False) -> None:
+        job = self.get("batch/v1", "Job", namespace, name)
+        assert job is not None, f"no job {namespace}/{name}"
+        cond = {"type": "Failed" if failed else "Complete", "status": "True"}
+        job.setdefault("status", {})["conditions"] = [cond]
+        if not failed:
+            job["status"]["succeeded"] = 1
+        self.update_status(job)
+
+    def mark_pod_ready(self, namespace: str, name: str) -> None:
+        pod = self.get("v1", "Pod", namespace, name)
+        assert pod is not None, f"no pod {namespace}/{name}"
+        pod.setdefault("status", {})["phase"] = "Running"
+        pod["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+        self.update_status(pod)
+
+    def mark_deployment_ready(self, namespace: str, name: str,
+                              replicas: int = 1) -> None:
+        dep = self.get("apps/v1", "Deployment", namespace, name)
+        assert dep is not None, f"no deployment {namespace}/{name}"
+        dep.setdefault("status", {})["readyReplicas"] = replicas
+        dep["status"]["replicas"] = replicas
+        self.update_status(dep)

@@ -1,0 +1,157 @@
+"""Kubernetes object helpers over plain dicts.
+
+The whole orchestration layer treats K8s objects as dicts in manifest shape
+(what you'd kubectl-apply). Typed wrappers in runbooks_tpu.api add accessors
+for our CRDs; these helpers cover the generic metadata/condition machinery
+(reference analogs: api/v1/conditions.go, meta helpers used throughout
+internal/controller/).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Dict, List, Optional
+
+Obj = Dict[str, Any]
+
+
+def new(api_version: str, kind: str, name: str, namespace: str = "default",
+        spec: Optional[dict] = None, labels: Optional[dict] = None,
+        annotations: Optional[dict] = None) -> Obj:
+    meta: Dict[str, Any] = {"name": name, "namespace": namespace}
+    if labels:
+        meta["labels"] = dict(labels)
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    obj: Obj = {"apiVersion": api_version, "kind": kind, "metadata": meta}
+    if spec is not None:
+        obj["spec"] = spec
+    return obj
+
+
+def name(obj: Obj) -> str:
+    return obj.get("metadata", {}).get("name", "")
+
+
+def namespace(obj: Obj) -> str:
+    return obj.get("metadata", {}).get("namespace", "default")
+
+
+def kind(obj: Obj) -> str:
+    return obj.get("kind", "")
+
+
+def api_version(obj: Obj) -> str:
+    return obj.get("apiVersion", "")
+
+
+def uid(obj: Obj) -> str:
+    return obj.get("metadata", {}).get("uid", "")
+
+
+def key(obj: Obj) -> str:
+    return f"{api_version(obj)}/{kind(obj)}/{namespace(obj)}/{name(obj)}"
+
+
+def labels(obj: Obj) -> Dict[str, str]:
+    return obj.get("metadata", {}).get("labels", {}) or {}
+
+
+def annotations(obj: Obj) -> Dict[str, str]:
+    return obj.get("metadata", {}).get("annotations", {}) or {}
+
+
+def set_annotation(obj: Obj, k: str, v: str) -> None:
+    obj.setdefault("metadata", {}).setdefault("annotations", {})[k] = v
+
+
+def owner_reference(owner: Obj, controller: bool = True) -> dict:
+    return {
+        "apiVersion": api_version(owner),
+        "kind": kind(owner),
+        "name": name(owner),
+        "uid": uid(owner),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+
+
+def set_owner(obj: Obj, owner: Obj) -> None:
+    refs = obj.setdefault("metadata", {}).setdefault("ownerReferences", [])
+    ref = owner_reference(owner)
+    for existing in refs:
+        if existing.get("uid") == ref["uid"]:
+            return
+    refs.append(ref)
+
+
+def deep_get(obj: Obj, *path: str, default=None):
+    node: Any = obj
+    for p in path:
+        if not isinstance(node, dict) or p not in node:
+            return default
+        node = node[p]
+    return node
+
+
+def deep_set(obj: Obj, value: Any, *path: str) -> None:
+    node = obj
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+# ---------------------------------------------------------------------------
+# Conditions (mirrors the metav1.Condition convention the reference uses)
+# ---------------------------------------------------------------------------
+
+def get_condition(obj: Obj, ctype: str) -> Optional[dict]:
+    for c in deep_get(obj, "status", "conditions", default=[]) or []:
+        if c.get("type") == ctype:
+            return c
+    return None
+
+
+def set_condition(obj: Obj, ctype: str, status: bool, reason: str,
+                  message: str = "", generation: Optional[int] = None) -> bool:
+    """Upsert a condition; returns True if it changed."""
+    conds: List[dict] = obj.setdefault("status", {}).setdefault(
+        "conditions", [])
+    new_c = {
+        "type": ctype,
+        "status": "True" if status else "False",
+        "reason": reason,
+        "message": message,
+        "observedGeneration": generation
+        if generation is not None else deep_get(obj, "metadata", "generation",
+                                                default=0),
+    }
+    for i, c in enumerate(conds):
+        if c.get("type") == ctype:
+            if (c.get("status") == new_c["status"]
+                    and c.get("reason") == new_c["reason"]
+                    and c.get("message") == new_c["message"]):
+                return False
+            new_c["lastTransitionTime"] = (
+                c.get("lastTransitionTime")
+                if c.get("status") == new_c["status"]
+                else _now())
+            conds[i] = new_c
+            return True
+    new_c["lastTransitionTime"] = _now()
+    conds.append(new_c)
+    return True
+
+
+def is_condition_true(obj: Obj, ctype: str) -> bool:
+    c = get_condition(obj, ctype)
+    return bool(c and c.get("status") == "True")
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def clone(obj: Obj) -> Obj:
+    return copy.deepcopy(obj)

@@ -7,23 +7,13 @@ parameters are traced scalars, not Python branches.
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 
-
-@dataclasses.dataclass(frozen=True)
-class SamplingConfig:
-    temperature: float = 1.0
-    top_k: int = 0          # 0 = disabled
-    top_p: float = 1.0      # 1.0 = disabled
-    # Static width of the sorted lane used for top-k/top-p (compile-time).
-    # Requests with top_k=0 AND top_p=1.0 sample the full vocab; requests
-    # using top_p are truncated to this lane (an explicit engineering cap —
-    # mass beyond the top max_top_k logits is negligible for real models).
-    max_top_k: int = 64
+# max_top_k (the static sorted-lane width): requests with top_k=0 AND
+# top_p=1.0 sample the full vocab; requests using top_p are truncated to the
+# lane (an explicit engineering cap — probability mass beyond the top
+# max_top_k logits is negligible for real models).
 
 
 def sample(

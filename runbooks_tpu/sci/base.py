@@ -1,0 +1,112 @@
+"""SCI — the cloud-interface microservice boundary.
+
+Three operations, mirroring the reference's gRPC service exactly (reference:
+internal/sci/sci.proto: CreateSignedURL, GetObjectMd5, BindIdentity; dialed
+by the controller at startup — cmd/controllermanager/main.go). Controllers
+talk to a ``SCIClient``; implementations:
+
+- ``FakeSCI``        — records calls, returns canned URLs (envtest analog of
+                       internal/sci/fake_sci_client.go).
+- ``LocalSCI``       — filesystem bucket + local HTTP upload endpoint
+                       (reference: internal/sci/kind/server.go).
+- ``runbooks_tpu.sci.grpc_service`` — the out-of-process gRPC server/client
+                       pair wrapping any of the above.
+- GCP/AWS impls      — cloud-API-backed; gated on their SDKs (not available
+                       in this image; interfaces + glue are here, the API
+                       calls raise with instructions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import time
+from typing import Dict, List, Optional, Protocol
+
+DEFAULT_EXPIRY_SECONDS = 300  # same signed-URL lifetime as the reference
+
+
+class SCIClient(Protocol):
+    def create_signed_url(self, bucket_name: str, object_name: str,
+                          expiration_seconds: int = DEFAULT_EXPIRY_SECONDS,
+                          md5_checksum: str = "") -> str: ...
+
+    def get_object_md5(self, bucket_name: str, object_name: str
+                       ) -> Optional[str]: ...
+
+    def bind_identity(self, principal: str, ksa: str,
+                      namespace: str) -> None: ...
+
+
+@dataclasses.dataclass
+class FakeSCI:
+    """Test double: canned signed URLs, settable object MD5s, recorded
+    identity bindings."""
+
+    objects: Dict[str, str] = dataclasses.field(default_factory=dict)
+    bindings: List[tuple] = dataclasses.field(default_factory=list)
+    signed: List[tuple] = dataclasses.field(default_factory=list)
+
+    def create_signed_url(self, bucket_name, object_name,
+                          expiration_seconds=DEFAULT_EXPIRY_SECONDS,
+                          md5_checksum=""):
+        self.signed.append((bucket_name, object_name, md5_checksum))
+        return f"https://signed.example/{bucket_name}/{object_name}"
+
+    def get_object_md5(self, bucket_name, object_name):
+        return self.objects.get(f"{bucket_name}/{object_name}")
+
+    def bind_identity(self, principal, ksa, namespace):
+        self.bindings.append((principal, ksa, namespace))
+
+
+class LocalSCI:
+    """Filesystem bucket: signed URLs point at a local HTTP PUT endpoint
+    (sci.http_endpoint serves it); MD5s come from sidecar files written on
+    upload, or are computed on demand."""
+
+    def __init__(self, root: str, endpoint: str = "http://localhost:30080"):
+        self.root = os.path.abspath(root)
+        self.endpoint = endpoint.rstrip("/")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, bucket_name: str, object_name: str) -> str:
+        return os.path.join(self.root, bucket_name.strip("/"),
+                            object_name.strip("/"))
+
+    def create_signed_url(self, bucket_name, object_name,
+                          expiration_seconds=DEFAULT_EXPIRY_SECONDS,
+                          md5_checksum=""):
+        expiry = int(time.time()) + expiration_seconds
+        return (f"{self.endpoint}/{bucket_name.strip('/')}/"
+                f"{object_name.strip('/')}?expiry={expiry}")
+
+    def get_object_md5(self, bucket_name, object_name):
+        path = self._path(bucket_name, object_name)
+        sidecar = path + ".md5"
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                return f.read().strip()
+        if os.path.exists(path):
+            h = hashlib.md5()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            return h.hexdigest()
+        return None
+
+    def put_object(self, bucket_name: str, object_name: str,
+                   data: bytes) -> str:
+        """Store bytes + md5 sidecar (what the HTTP PUT endpoint calls)."""
+        path = self._path(bucket_name, object_name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+        md5 = hashlib.md5(data).hexdigest()
+        with open(path + ".md5", "w") as f:
+            f.write(md5)
+        return md5
+
+    def bind_identity(self, principal, ksa, namespace):
+        return None  # identity is a no-op locally

@@ -88,6 +88,9 @@ class EngineWorker:
         self._thread.start()
 
     def submit(self, req: Request) -> Future:
+        # Validate synchronously so unservable requests raise here (-> 400)
+        # instead of blowing up the worker loop and dooming other requests.
+        self.engine.validate(req)
         fut: Future = Future()
         with self._lock:
             self._pending.append((req, fut))
@@ -124,9 +127,9 @@ class EngineWorker:
                 for _req, fut in doomed:
                     if not fut.done():
                         fut.set_exception(exc)
-                self.engine.active[:] = False
-                self.engine.slot_req = [None] * self.engine.max_slots
-                self.engine.queue.clear()
+                # Donated buffers (cache) may have been invalidated by the
+                # failed call — full reset reallocates them.
+                self.engine.reset()
 
     def stop(self) -> None:
         self._stop = True
@@ -166,8 +169,12 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             return web.json_response(
                 {"error": {"message": "missing required field: prompt"}},
                 status=400)
-        if isinstance(prompt, list):
-            prompt = prompt[0] if prompt else ""
+        prompts = prompt if isinstance(prompt, list) else [prompt]
+        if not prompts or not all(isinstance(p, str) for p in prompts):
+            return web.json_response(
+                {"error": {"message": "prompt must be a string or a "
+                                      "non-empty list of strings"}},
+                status=400)
         try:
             max_tokens = int(body.get("max_tokens", 16))
             temperature = float(body.get("temperature", 1.0))
@@ -182,42 +189,59 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 {"error": {"message": "max_tokens must be >= 1"}}, status=400)
 
         tok = request.app["tokenizer"]
-        ids = tok.encode(prompt, add_bos=True, add_eos=False) \
-            if hasattr(tok, "bos_id") else tok.encode(prompt)
         eos = getattr(tok, "eos_id", None) or getattr(tok, "eos_token_id",
                                                       None)
-        req = Request(prompt_tokens=list(ids), max_tokens=max_tokens,
-                      temperature=temperature, top_k=top_k, top_p=top_p,
-                      eos_id=eos)
-        fut = request.app["worker"].submit(req)
+        reqs = []
+        for p in prompts:
+            ids = tok.encode(p, add_bos=True, add_eos=False) \
+                if hasattr(tok, "bos_id") else tok.encode(p)
+            reqs.append(Request(
+                prompt_tokens=list(ids), max_tokens=max_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos))
+        worker = request.app["worker"]
         try:
-            done = await asyncio.wait_for(asyncio.wrap_future(fut),
-                                          timeout=600)
+            futs = [asyncio.wrap_future(worker.submit(r)) for r in reqs]
+        except ValueError as exc:  # e.g. prompt exceeds the context window
+            return web.json_response(
+                {"error": {"message": str(exc)}}, status=400)
+        try:
+            done_reqs = await asyncio.wait_for(
+                asyncio.gather(*futs), timeout=600)
         except asyncio.TimeoutError:
             return web.json_response(
                 {"error": {"message": "generation timed out"}}, status=504)
+        except ValueError as exc:
+            return web.json_response(
+                {"error": {"message": str(exc)}}, status=400)
         except Exception as exc:  # noqa: BLE001 — engine failure surfaced
             return web.json_response(
                 {"error": {"message": f"engine failure: {exc}"}}, status=500)
-        out_ids = done.output_tokens
-        if eos is not None and out_ids and out_ids[-1] == eos:
-            out_ids = out_ids[:-1]
-        text = tok.decode(out_ids)
+
+        choices = []
+        prompt_tokens = completion_tokens = 0
+        for i, done in enumerate(done_reqs):
+            out_ids = done.output_tokens
+            if eos is not None and out_ids and out_ids[-1] == eos:
+                out_ids = out_ids[:-1]
+            choices.append({
+                "index": i,
+                "text": tok.decode(out_ids),
+                "finish_reason": done.finish_reason,
+                "logprobs": None,
+            })
+            prompt_tokens += len(reqs[i].prompt_tokens)
+            completion_tokens += len(done.output_tokens)
         return web.json_response({
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "created": int(time.time()),
             "model": request.app["model_name"],
-            "choices": [{
-                "index": 0,
-                "text": text,
-                "finish_reason": done.finish_reason,
-                "logprobs": None,
-            }],
+            "choices": choices,
             "usage": {
-                "prompt_tokens": len(ids),
-                "completion_tokens": len(done.output_tokens),
-                "total_tokens": len(ids) + len(done.output_tokens),
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens,
             },
         })
 

@@ -119,10 +119,28 @@ class InferenceEngine:
     # Request lifecycle
     # ------------------------------------------------------------------
 
-    def submit(self, req: Request) -> None:
+    def validate(self, req: Request) -> None:
+        """Raise ValueError for requests that can never be served (callers
+        should surface this as a 400, before the request enters the queue)."""
         if len(req.prompt_tokens) >= self.max_seq_len:
-            req.prompt_tokens = req.prompt_tokens[-(self.max_seq_len - 1):]
+            raise ValueError(
+                f"prompt of {len(req.prompt_tokens)} tokens exceeds the "
+                f"engine's context window ({self.max_seq_len})")
+
+    def submit(self, req: Request) -> None:
+        self.validate(req)
         self.queue.append(req)
+
+    def reset(self) -> None:
+        """Recover from a failed jitted step: donated cache buffers may be
+        invalid, so reallocate, and clear all slot state."""
+        self.cache = KVCache.create(self.cfg, self.max_slots,
+                                    self.max_seq_len, trash_slot=True)
+        self.lengths[:] = 0
+        self.active[:] = False
+        self.last_token[:] = 0
+        self.slot_req = [None] * self.max_slots
+        self.queue.clear()
 
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any())

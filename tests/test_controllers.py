@@ -1,0 +1,334 @@
+"""Reconciler tests against the in-memory fake cluster.
+
+Mirrors the reference's envtest technique (SURVEY.md §4): no kubelet, so
+tests simulate runtime by marking Jobs complete / Pods ready / Deployments
+available, then assert the reconcilers converge conditions and status.
+"""
+
+import pytest
+
+from runbooks_tpu.api import conditions as cond
+from runbooks_tpu.api.types import (
+    API_VERSION,
+    Dataset,
+    Model,
+    Notebook,
+    Server,
+)
+from runbooks_tpu.cloud.base import CommonConfig
+from runbooks_tpu.cloud.local import LocalCloud
+from runbooks_tpu.controller.build import BuildReconciler
+from runbooks_tpu.controller.dataset import DatasetReconciler
+from runbooks_tpu.controller.manager import Ctx, Manager
+from runbooks_tpu.controller.model import ModelReconciler
+from runbooks_tpu.controller.notebook import NotebookReconciler
+from runbooks_tpu.controller.server import ServerReconciler
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.k8s.fake import FakeCluster
+from runbooks_tpu.sci.base import FakeSCI
+
+
+@pytest.fixture()
+def harness(tmp_path):
+    client = FakeCluster()
+    cloud = LocalCloud(CommonConfig(
+        cluster_name="testcluster",
+        artifact_bucket_url=f"file://{tmp_path}/bucket",
+        registry_url="registry.local:5000"))
+    sci = FakeSCI()
+    ctx = Ctx(client=client, cloud=cloud, sci=sci)
+    mgr = Manager(ctx, [
+        BuildReconciler("Model"), BuildReconciler("Dataset"),
+        BuildReconciler("Server"), BuildReconciler("Notebook"),
+        ModelReconciler(), DatasetReconciler(), ServerReconciler(),
+        NotebookReconciler(),
+    ])
+    return client, cloud, sci, mgr
+
+
+def get(client, kind, name, ns="default"):
+    return client.get(API_VERSION, kind, ns, name)
+
+
+# ---------------------------------------------------------------------------
+# Build reconciler
+# ---------------------------------------------------------------------------
+
+def test_build_upload_handshake_and_job(harness):
+    client, cloud, sci, mgr = harness
+    m = Model.new("m1", spec={
+        "build": {"upload": {"md5checksum": "abc123", "requestID": "r1"}}})
+    client.create(m.obj)
+    mgr.reconcile_until_stable()
+
+    cur = Model(get(client, "Model", "m1"))
+    # Signed URL issued for this requestID; Uploaded=False until storage
+    # has the right md5.
+    assert cur.upload_status["signedURL"].startswith("https://signed.example/")
+    assert cur.upload_status["requestID"] == "r1"
+    assert not cur.condition_true(cond.UPLOADED)
+    assert len(sci.signed) >= 1
+
+    # Simulate the client PUTting the tarball (storage now has the md5).
+    bucket, obj_name = sci.signed[-1][0], sci.signed[-1][1]
+    sci.objects[f"{bucket}/{obj_name}"] = "abc123"
+    mgr.reconcile_until_stable()
+
+    cur = Model(get(client, "Model", "m1"))
+    assert cur.condition_true(cond.UPLOADED)
+    # Build job created with the image annotation; not yet Built.
+    job = client.get("batch/v1", "Job", "default", "m1-model-bld")
+    assert job is not None
+    target = ko.annotations(job)["runbooks-tpu.dev/target-image"]
+    assert target.startswith("registry.local:5000/testcluster-model-default-m1:")
+    assert not cur.condition_true(cond.BUILT)
+
+    client.mark_job_complete("default", "m1-model-bld")
+    mgr.reconcile_until_stable()
+    cur = Model(get(client, "Model", "m1"))
+    assert cur.condition_true(cond.BUILT)
+    assert cur.image == target
+    # container-builder SA reconciled
+    assert client.get("v1", "ServiceAccount", "default",
+                      "container-builder") is not None
+
+
+def test_build_git_job_args(harness):
+    client, cloud, sci, mgr = harness
+    m = Model.new("m2", spec={
+        "build": {"git": {"url": "https://example.com/repo.git",
+                          "branch": "dev", "path": "img"}}})
+    client.create(m.obj)
+    mgr.reconcile_until_stable()
+    job = client.get("batch/v1", "Job", "default", "m2-model-bld")
+    assert job is not None
+    init = job["spec"]["template"]["spec"]["initContainers"][0]
+    assert init["args"][:2] == ["clone", "https://example.com/repo.git"]
+    kaniko = job["spec"]["template"]["spec"]["containers"][0]
+    assert any(a == "--context=dir:///workspace/img" for a in kaniko["args"])
+    # tag derives from the branch
+    assert any(a.endswith(":dev") for a in kaniko["args"]
+               if a.startswith("--destination="))
+
+
+# ---------------------------------------------------------------------------
+# Model reconciler
+# ---------------------------------------------------------------------------
+
+def test_model_job_lifecycle(harness):
+    client, cloud, sci, mgr = harness
+    m = Model.new("imp", spec={"image": "loader:latest",
+                               "params": {"name": "opt-125m"}})
+    client.create(m.obj)
+    mgr.reconcile_until_stable()
+
+    job = client.get("batch/v1", "Job", "default", "imp-modeller")
+    assert job is not None
+    assert job["spec"]["backoffLimit"] == 3  # cheap CPU import retries
+    cm = client.get("v1", "ConfigMap", "default", "imp-model-params")
+    assert cm is not None and "params.json" in cm["data"]
+    cur = Model(get(client, "Model", "imp"))
+    assert cur.artifacts_url.startswith("file://")
+    assert not cur.ready
+
+    # env contract: PARAM_* injected
+    env = {e["name"]: e.get("value")
+           for e in job["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env.get("PARAM_NAME") == "opt-125m"
+
+    client.mark_job_complete("default", "imp-modeller")
+    mgr.reconcile_until_stable()
+    cur = Model(get(client, "Model", "imp"))
+    assert cur.ready and cur.condition_true(cond.COMPLETE)
+
+
+def test_model_failed_job_sets_condition(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("bad", spec={"image": "x"}).obj)
+    mgr.reconcile_until_stable()
+    client.mark_job_complete("default", "bad-modeller", failed=True)
+    mgr.reconcile_until_stable()
+    cur = Model(get(client, "Model", "bad"))
+    assert not cur.ready
+    c = ko.get_condition(cur.obj, cond.COMPLETE)
+    assert c["status"] == "False" and c["reason"] == cond.REASON_JOB_FAILED
+
+
+def test_model_dependency_chain(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Dataset.new("d", spec={"image": "loader"}).obj)
+    client.create(Model.new("base", spec={"image": "loader"}).obj)
+    client.create(Model.new("ft", spec={
+        "image": "trainer", "model": {"name": "base"},
+        "dataset": {"name": "d"}}).obj)
+    mgr.reconcile_until_stable()
+
+    # Gated: no modeller job until base+dataset are ready.
+    assert client.get("batch/v1", "Job", "default", "ft-modeller") is None
+    cur = Model(get(client, "Model", "ft"))
+    c = ko.get_condition(cur.obj, cond.COMPLETE)
+    assert c["status"] == "False"
+
+    client.mark_job_complete("default", "d-data-loader")
+    client.mark_job_complete("default", "base-modeller")
+    mgr.reconcile_until_stable()
+    job = client.get("batch/v1", "Job", "default", "ft-modeller")
+    assert job is not None
+    mounts = {m["mountPath"] for c in
+              job["spec"]["template"]["spec"]["containers"]
+              for m in c["volumeMounts"]}
+    assert {"/content/artifacts", "/content/data", "/content/model",
+            "/content/params.json"} <= mounts
+
+    client.mark_job_complete("default", "ft-modeller")
+    mgr.reconcile_until_stable()
+    assert Model(get(client, "Model", "ft")).ready
+
+
+def test_model_tpu_multihost_fanout(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("big", spec={
+        "image": "trainer",
+        "resources": {"tpu": {"type": "v5e", "topology": "2x4"}}}).obj)
+    mgr.reconcile_until_stable()
+    job = client.get("batch/v1", "Job", "default", "big-modeller")
+    assert job is not None
+    spec = job["spec"]
+    assert spec["completions"] == 2 and spec["parallelism"] == 2
+    assert spec["completionMode"] == "Indexed"
+    assert spec["backoffLimit"] == 0  # expensive TPU job: no blind retry
+    pod = spec["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    assert "JAX_COORDINATOR_ADDRESS" in env
+    assert env["JAX_NUM_PROCESSES"]["value"] == "2"
+    res = pod["containers"][0]["resources"]
+    assert res["requests"]["google.com/tpu"] == "4"
+    # headless service for stable pod DNS
+    svc = client.get("v1", "Service", "default", "big-modeller")
+    assert svc is not None and svc["spec"]["clusterIP"] == "None"
+
+
+# ---------------------------------------------------------------------------
+# Server reconciler
+# ---------------------------------------------------------------------------
+
+def test_server_lifecycle(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("m", spec={"image": "loader"}).obj)
+    client.create(Server.new("srv", spec={
+        "image": "server-img", "model": {"name": "m"}}).obj)
+    mgr.reconcile_until_stable()
+    # Gated on model readiness.
+    assert client.get("apps/v1", "Deployment", "default", "srv") is None
+
+    client.mark_job_complete("default", "m-modeller")
+    mgr.reconcile_until_stable()
+    dep = client.get("apps/v1", "Deployment", "default", "srv")
+    svc = client.get("v1", "Service", "default", "srv")
+    assert dep is not None and svc is not None
+    assert svc["spec"]["ports"][0]["port"] == 80
+    assert svc["spec"]["ports"][0]["targetPort"] == 8080
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert container["readinessProbe"]["httpGet"]["path"] == "/"
+    mounts = {m["mountPath"] for m in container["volumeMounts"]}
+    assert "/content/model" in mounts
+    cur = Server(get(client, "Server", "srv"))
+    assert not cur.ready
+
+    client.mark_deployment_ready("default", "srv")
+    mgr.reconcile_until_stable()
+    cur = Server(get(client, "Server", "srv"))
+    assert cur.ready and cur.condition_true(cond.SERVING)
+
+
+def test_server_requires_model(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Server.new("s2", spec={"image": "img"}).obj)
+    mgr.reconcile_until_stable()
+    cur = Server(get(client, "Server", "s2"))
+    c = ko.get_condition(cur.obj, cond.SERVING)
+    assert c["status"] == "False"
+    assert c["reason"] == cond.REASON_MODEL_NOT_FOUND
+
+
+# ---------------------------------------------------------------------------
+# Notebook reconciler
+# ---------------------------------------------------------------------------
+
+def test_notebook_lifecycle_and_suspend(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Notebook.new("nb", spec={"image": "nb-img"}).obj)
+    mgr.reconcile_until_stable()
+    pod = client.get("v1", "Pod", "default", "nb-notebook")
+    assert pod is not None
+    container = pod["spec"]["containers"][0]
+    assert container["ports"][0]["containerPort"] == 8888
+    assert container["readinessProbe"]["httpGet"]["path"] == "/api"
+    assert container["command"][0] == "jupyter"
+
+    client.mark_pod_ready("default", "nb-notebook")
+    mgr.reconcile_until_stable()
+    assert Notebook(get(client, "Notebook", "nb")).ready
+
+    # Suspend deletes the pod and flips conditions.
+    cur = get(client, "Notebook", "nb")
+    cur["spec"]["suspend"] = True
+    client.update(cur)
+    mgr.reconcile_until_stable()
+    assert client.get("v1", "Pod", "default", "nb-notebook") is None
+    nb = Notebook(get(client, "Notebook", "nb"))
+    assert nb.condition_true(cond.SUSPENDED) and not nb.ready
+
+    # Resume recreates it.
+    cur = get(client, "Notebook", "nb")
+    cur["spec"]["suspend"] = False
+    client.update(cur)
+    mgr.reconcile_until_stable()
+    assert client.get("v1", "Pod", "default", "nb-notebook") is not None
+
+
+def test_notebook_recreated_on_spec_change(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Notebook.new("nb2", spec={"image": "img:v1"}).obj)
+    mgr.reconcile_until_stable()
+    pod1 = client.get("v1", "Pod", "default", "nb2-notebook")
+    cur = get(client, "Notebook", "nb2")
+    cur["spec"]["image"] = "img:v2"
+    client.update(cur)
+    mgr.reconcile_until_stable()
+    pod2 = client.get("v1", "Pod", "default", "nb2-notebook")
+    assert pod2["spec"]["containers"][0]["image"] == "img:v2"
+    assert pod2["metadata"]["uid"] != pod1["metadata"]["uid"]
+
+
+# ---------------------------------------------------------------------------
+# Full end-to-end chain (the system-test analog)
+# ---------------------------------------------------------------------------
+
+def test_e2e_dataset_model_server(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Dataset.new("squad", spec={"image": "loader"}).obj)
+    client.create(Model.new("llm", spec={
+        "image": "trainer", "dataset": {"name": "squad"},
+        "resources": {"tpu": {"type": "v5e", "topology": "2x2"}}}).obj)
+    client.create(Server.new("api", spec={
+        "image": "server", "model": {"name": "llm"}}).obj)
+
+    mgr.reconcile_until_stable()
+    client.mark_job_complete("default", "squad-data-loader")
+    mgr.reconcile_until_stable()
+    client.mark_job_complete("default", "llm-modeller")
+    mgr.reconcile_until_stable()
+    client.mark_deployment_ready("default", "api")
+    mgr.reconcile_until_stable()
+
+    assert Dataset(get(client, "Dataset", "squad")).ready
+    assert Model(get(client, "Model", "llm")).ready
+    srv = Server(get(client, "Server", "api"))
+    assert srv.ready and srv.condition_true(cond.SERVING)
+    # single-host 2x2: plain job, no fan-out service
+    job = client.get("batch/v1", "Job", "default", "llm-modeller")
+    assert "completionMode" not in job["spec"]

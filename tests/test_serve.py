@@ -133,6 +133,13 @@ def test_http_api_end_to_end():
             assert body["choices"][0]["finish_reason"] in ("length", "stop")
             assert body["usage"]["completion_tokens"] >= 1
 
+            # batch (list) prompt: one choice per element
+            r = await client.post("/v1/completions", json={
+                "prompt": ["a", "bb"], "max_tokens": 3, "temperature": 0.0})
+            assert r.status == 200
+            body = await r.json()
+            assert [c["index"] for c in body["choices"]] == [0, 1]
+
             # malformed requests
             r = await client.post("/v1/completions", json={"max_tokens": 4})
             assert r.status == 400
@@ -141,5 +148,11 @@ def test_http_api_end_to_end():
             r = await client.post("/v1/completions", json={
                 "prompt": "x", "max_tokens": 0})
             assert r.status == 400
+            # over-long prompt -> 400, not silent truncation
+            r = await client.post("/v1/completions", json={
+                "prompt": "x" * 500, "max_tokens": 4})
+            assert r.status == 400
+            body = await r.json()
+            assert "context window" in body["error"]["message"]
 
     asyncio.run(drive())
