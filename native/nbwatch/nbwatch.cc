@@ -1,0 +1,141 @@
+// nbwatch — file-change watcher for the notebook sync loop.
+//
+// Native C++ equivalent of the reference's Go fsnotify tool (reference:
+// containertools/cmd/nbwatch/main.go): watches a root directory (default
+// /content) non-recursively plus its first-level subdirectories, skipping
+// the contract mounts (data/, model/, artifacts/) and dotfiles, and emits
+// one JSON object per event on stdout:
+//
+//   {"index":0,"path":"/content/train.py","op":"WRITE"}
+//
+// The CLI-side sync loop (runbooks_tpu/utils/sync.py) execs this inside the
+// notebook pod and mirrors changed files back to the workstation.
+//
+// Build: make -C native/nbwatch   (static-ish, no deps beyond libc/libstdc++)
+
+#include <sys/inotify.h>
+#include <sys/stat.h>
+
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <map>
+#include <string>
+#include <unistd.h>
+
+namespace {
+
+const char *kSkipDirs[] = {"data", "model", "artifacts"};
+
+bool ShouldSkipDir(const std::string &name) {
+  if (!name.empty() && name[0] == '.') return true;
+  for (const char *skip : kSkipDirs) {
+    if (name == skip) return true;
+  }
+  return false;
+}
+
+bool ShouldSkipFile(const std::string &name) {
+  return name.empty() || name[0] == '.' || name.back() == '~';
+}
+
+const char *OpName(uint32_t mask) {
+  if (mask & IN_CREATE) return "CREATE";
+  if (mask & IN_CLOSE_WRITE) return "WRITE";
+  if (mask & IN_MODIFY) return "WRITE";
+  if (mask & (IN_MOVED_FROM | IN_MOVE_SELF)) return "RENAME";
+  if (mask & IN_MOVED_TO) return "CREATE";
+  if (mask & IN_DELETE) return "REMOVE";
+  return "OTHER";
+}
+
+void JsonEscape(const std::string &in, std::string *out) {
+  for (char c : in) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          *out += buf;
+        } else {
+          *out += c;
+        }
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  std::string root = argc > 1 ? argv[1] : "/content";
+  int fd = inotify_init1(IN_CLOEXEC);
+  if (fd < 0) {
+    perror("inotify_init1");
+    return 1;
+  }
+
+  const uint32_t mask = IN_CLOSE_WRITE | IN_CREATE | IN_DELETE |
+                        IN_MOVED_FROM | IN_MOVED_TO;
+  std::map<int, std::string> watch_dirs;
+
+  auto add_watch = [&](const std::string &dir) {
+    int wd = inotify_add_watch(fd, dir.c_str(), mask);
+    if (wd >= 0) {
+      watch_dirs[wd] = dir;
+      fprintf(stderr, "nbwatch: watching %s\n", dir.c_str());
+    }
+  };
+
+  // Root + first-level subdirectories (non-recursive, like the reference).
+  add_watch(root);
+  if (DIR *d = opendir(root.c_str())) {
+    while (dirent *ent = readdir(d)) {
+      std::string name = ent->d_name;
+      if (name == "." || name == ".." || ShouldSkipDir(name)) continue;
+      std::string full = root + "/" + name;
+      struct stat st;
+      if (stat(full.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
+        add_watch(full);
+      }
+    }
+    closedir(d);
+  }
+
+  long index = 0;
+  char buf[4096 * 4];
+  for (;;) {
+    ssize_t len = read(fd, buf, sizeof buf);
+    if (len <= 0) {
+      if (len < 0 && errno == EINTR) continue;
+      break;
+    }
+    for (char *p = buf; p < buf + len;) {
+      auto *ev = reinterpret_cast<inotify_event *>(p);
+      p += sizeof(inotify_event) + ev->len;
+      if (ev->len == 0) continue;
+      std::string name = ev->name;
+      auto it = watch_dirs.find(ev->wd);
+      if (it == watch_dirs.end()) continue;
+      if (ev->mask & IN_ISDIR) {
+        // New first-level directory: start watching it (unless skipped).
+        if ((ev->mask & IN_CREATE) && it->second == root &&
+            !ShouldSkipDir(name)) {
+          add_watch(it->second + "/" + name);
+        }
+        continue;
+      }
+      if (ShouldSkipFile(name)) continue;
+      std::string path = it->second + "/" + name;
+      std::string escaped;
+      JsonEscape(path, &escaped);
+      printf("{\"index\":%ld,\"path\":\"%s\",\"op\":\"%s\"}\n", index++,
+             escaped.c_str(), OpName(ev->mask));
+      fflush(stdout);
+    }
+  }
+  return 0;
+}

@@ -1,21 +1,428 @@
-"""`rbt` — the runbooks-tpu dev CLI (reference analog: cmd/sub, internal/cli).
+"""`rbt` — the runbooks-tpu dev CLI.
 
-Round-1 stub: subcommands land with the orchestration layer (apply/run/
-serve/get/delete/notebook).
+Command parity with the reference's `sub` CLI (reference: cmd/sub/main.go,
+internal/cli/root.go — apply, run, get, delete, serve, notebook), built on
+the same client primitives (SSA apply, upload handshake, watch-based
+readiness). Where the reference runs a bubbletea TUI, rbt prints live
+condition updates; port-forwarding shells out to kubectl (the reference
+shells out to kubectl for cp the same way — internal/client/cp/kubectl.go).
+
+Manifest discovery mirrors internal/tui/manifests.go: a path, file, or URL
+yields YAML docs; non-runbooks kinds are skipped; kinds are applied in
+dependency-friendly order (Dataset, Model, Server, Notebook).
 """
 
 from __future__ import annotations
 
+import argparse
+import json
+import os
+import re
+import subprocess
 import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+import yaml
+
+from runbooks_tpu.api.types import API_VERSION, KINDS, wrap
+from runbooks_tpu.k8s import objects as ko
+
+KIND_ORDER = {"Dataset": 0, "Model": 1, "Server": 2, "Notebook": 3}
+
+
+def context_dir(filename: str) -> str:
+    """Build-context directory for -f: the directory itself when -f is a
+    directory, else the file's directory."""
+    if os.path.isdir(filename):
+        return filename
+    return os.path.dirname(os.path.abspath(filename)) or "."
+
+
+def make_client(args):
+    if os.environ.get("RBT_FAKE"):
+        # Hermetic/demo mode: a process-local fake cluster (useful with
+        # STANDALONE controller or for dry-runs/tests).
+        from runbooks_tpu.k8s.fake import FakeCluster
+
+        return FakeCluster()
+    from runbooks_tpu.k8s.client import K8sClient, KubeConfig
+
+    cfg = (KubeConfig.from_kubeconfig(args.kubeconfig)
+           if getattr(args, "kubeconfig", None) else KubeConfig.auto())
+    return K8sClient(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Manifests
+# ---------------------------------------------------------------------------
+
+def load_manifests(path: str, namespace: str) -> List[dict]:
+    docs: List[dict] = []
+    if re.match(r"^https?://", path):
+        with urllib.request.urlopen(path, timeout=30) as resp:
+            docs = list(yaml.safe_load_all(resp.read()))
+    elif os.path.isdir(path):
+        for fname in sorted(os.listdir(path)):
+            if fname.endswith((".yaml", ".yml")):
+                with open(os.path.join(path, fname)) as f:
+                    docs.extend(yaml.safe_load_all(f))
+    else:
+        with open(path) as f:
+            docs = list(yaml.safe_load_all(f))
+    out = []
+    for doc in docs:
+        if not isinstance(doc, dict) or doc.get("kind") not in KINDS:
+            continue
+        if doc.get("apiVersion") != API_VERSION:
+            continue
+        doc.setdefault("metadata", {}).setdefault("namespace", namespace)
+        out.append(doc)
+    out.sort(key=lambda d: KIND_ORDER.get(d["kind"], 9))
+    return out
+
+
+def parse_scope(scope: str) -> tuple[Optional[str], Optional[str]]:
+    """'models' / 'models/m1' / '' -> (Kind, name)."""
+    if not scope:
+        return None, None
+    part, _, name = scope.partition("/")
+    singular = part.rstrip("s").lower()
+    for kind in KINDS:
+        if kind.lower() == singular:
+            return kind, name or None
+    raise SystemExit(f"unknown kind {part!r}; expected one of "
+                     f"{[k.lower() + 's' for k in KINDS]}")
+
+
+# ---------------------------------------------------------------------------
+# Output helpers
+# ---------------------------------------------------------------------------
+
+def print_table(rows: List[List[str]], header: List[str]) -> None:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    def fmt(row):
+        return "  ".join(str(c).ljust(w) for c, w in zip(row, widths))
+    print(fmt(header))
+    for row in rows:
+        print(fmt(row))
+
+
+def condition_summary(obj: dict) -> str:
+    conds = ko.deep_get(obj, "status", "conditions", default=[]) or []
+    parts = []
+    for c in conds:
+        mark = "+" if c.get("status") == "True" else "-"
+        parts.append(f"{mark}{c.get('type')}")
+    return ",".join(parts)
+
+
+def wait_ready(client, obj: dict, timeout_s: float, quiet=False) -> bool:
+    kind, ns, name = ko.kind(obj), ko.namespace(obj), ko.name(obj)
+    deadline = time.monotonic() + timeout_s
+    last = ""
+    while time.monotonic() < deadline:
+        cur = client.get(API_VERSION, kind, ns, name)
+        if cur is None:
+            time.sleep(0.5)
+            continue
+        summary = condition_summary(cur)
+        if summary != last and not quiet:
+            print(f"  {kind}/{name}: {summary or 'pending'}")
+            last = summary
+        if ko.deep_get(cur, "status", "ready"):
+            if not quiet:
+                print(f"  {kind}/{name}: ready")
+            return True
+        time.sleep(0.5)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Commands
+# ---------------------------------------------------------------------------
+
+def cmd_apply(args) -> int:
+    client = make_client(args)
+    manifests = load_manifests(args.filename, args.namespace)
+    if not manifests:
+        print(f"no runbooks-tpu manifests found in {args.filename}",
+              file=sys.stderr)
+        return 1
+    for obj in manifests:
+        upload_dir = _upload_dir_for(obj, args)
+        if upload_dir:
+            from runbooks_tpu.utils.upload import upload_build_context
+
+            print(f"{obj['kind']}/{ko.name(obj)}: uploading build context "
+                  f"from {upload_dir}")
+            upload_build_context(client, obj, upload_dir,
+                                 progress=lambda m: print(f"  {m}"))
+        else:
+            client.apply(obj, "rbt-cli")
+            print(f"{obj['kind']}/{ko.name(obj)} applied")
+    if args.wait:
+        ok = all(wait_ready(client, o, args.timeout) for o in manifests)
+        return 0 if ok else 1
+    return 0
+
+
+def _upload_dir_for(obj: dict, args) -> Optional[str]:
+    build = ko.deep_get(obj, "spec", "build", default={}) or {}
+    if "upload" in build or getattr(args, "build", None):
+        # `rbt run/apply --build DIR` or a spec that asks for an upload.
+        return getattr(args, "build", None) or context_dir(args.filename)
+    return None
+
+
+def cmd_get(args) -> int:
+    client = make_client(args)
+    kind_filter, name_filter = parse_scope(args.scope)
+    rows = []
+    for kind in KINDS:
+        if kind_filter and kind != kind_filter:
+            continue
+        for obj in client.list(API_VERSION, kind,
+                               namespace=args.namespace):
+            if name_filter and ko.name(obj) != name_filter:
+                continue
+            ready = "True" if ko.deep_get(obj, "status", "ready") else "False"
+            rows.append([f"{kind.lower()}s/{ko.name(obj)}",
+                         ko.namespace(obj), ready, condition_summary(obj)])
+    if not rows:
+        print("no resources found")
+        return 0
+    print_table(rows, ["NAME", "NAMESPACE", "READY", "CONDITIONS"])
+    return 0
+
+
+def cmd_delete(args) -> int:
+    client = make_client(args)
+    if args.filename:
+        targets = [(d["kind"], ko.name(d))
+                   for d in load_manifests(args.filename, args.namespace)]
+    else:
+        kind, name = parse_scope(args.scope)
+        if not kind or not name:
+            raise SystemExit("usage: rbt delete <kind>/<name> | -f FILE")
+        targets = [(kind, name)]
+    for kind, name in targets:
+        ok = client.delete(API_VERSION, kind, args.namespace, name)
+        print(f"{kind.lower()}s/{name} " + ("deleted" if ok else "not found"))
+    return 0
+
+
+def _auto_increment_name(client, kind: str, namespace: str,
+                         base: str) -> str:
+    """base -> base-N with N = max existing + 1 (reference:
+    internal/tui/common.go name auto-increment)."""
+    pattern = re.compile(re.escape(base) + r"-(\d+)$")
+    top = 0
+    for obj in client.list(API_VERSION, kind, namespace=namespace):
+        m = pattern.match(ko.name(obj))
+        if m:
+            top = max(top, int(m.group(1)))
+        elif ko.name(obj) == base:
+            top = max(top, 0)
+    return f"{base}-{top + 1}"
+
+
+def cmd_run(args) -> int:
+    """Create-with-upload batch flow (reference: internal/tui/run.go +
+    `sub run`): package the CWD, create the object (auto-incremented name or
+    --replace), wait until it completes."""
+    client = make_client(args)
+    manifests = load_manifests(args.filename, args.namespace)
+    if not manifests:
+        print("no manifests found", file=sys.stderr)
+        return 1
+    rc = 0
+    for obj in manifests:
+        kind, ns, base = obj["kind"], ko.namespace(obj), ko.name(obj)
+        if args.replace:
+            client.delete(API_VERSION, kind, ns, base)
+        elif args.increment:
+            obj["metadata"]["name"] = _auto_increment_name(
+                client, kind, ns, base)
+        build = ko.deep_get(obj, "spec", "build", default={}) or {}
+        if args.build or "upload" in build:
+            from runbooks_tpu.utils.upload import upload_build_context
+
+            build_dir = args.build or context_dir(args.filename)
+            upload_build_context(client, obj, build_dir,
+                                 progress=lambda m: print(f"  {m}"))
+        else:
+            # git builds (and no-build objects) apply as-is; the build
+            # reconciler handles the rest server-side.
+            client.apply(obj, "rbt-cli")
+        print(f"{kind}/{ko.name(obj)} created")
+        if not wait_ready(client, obj, args.timeout):
+            print(f"{kind}/{ko.name(obj)} did not become ready",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_serve(args) -> int:
+    """Wait for a Server to be ready, then port-forward localhost:PORT ->
+    service 8080 (reference: internal/tui/serve.go)."""
+    client = make_client(args)
+    kind, name = parse_scope(args.scope)
+    if kind != "Server" or not name:
+        raise SystemExit("usage: rbt serve servers/<name>")
+    obj = client.get(API_VERSION, "Server", args.namespace, name)
+    if obj is None:
+        raise SystemExit(f"servers/{name} not found")
+    if not wait_ready(client, obj, args.timeout):
+        return 1
+    print(f"forwarding localhost:{args.port} -> service/{name}:80 "
+          f"(ctrl-c to stop)")
+    return _kubectl_port_forward(f"service/{name}", args.port, 80,
+                                 args.namespace)
+
+
+def cmd_notebook(args) -> int:
+    """Apply/derive a Notebook, upload the workspace, wait, port-forward 8888,
+    and sync files back (reference: internal/tui/notebook.go flow)."""
+    client = make_client(args)
+    manifests = load_manifests(args.filename, args.namespace)
+    nb = next((m for m in manifests if m["kind"] == "Notebook"), None)
+    if nb is None and manifests:
+        # Derive a notebook from another object's spec (reference:
+        # internal/client/notebook.go NotebookForObject).
+        src = manifests[0]
+        nb = {
+            "apiVersion": API_VERSION, "kind": "Notebook",
+            "metadata": {"name": ko.name(src),
+                         "namespace": args.namespace},
+            "spec": {k: v for k, v in src.get("spec", {}).items()
+                     if k in ("image", "build", "env", "params", "resources",
+                              "model", "dataset")},
+        }
+    if nb is None:
+        raise SystemExit("no notebook (or derivable object) found")
+    nb_build = ko.deep_get(nb, "spec", "build", default={}) or {}
+    if args.build or "upload" in nb_build:
+        from runbooks_tpu.utils.upload import upload_build_context
+
+        build_dir = args.build or context_dir(args.filename)
+        upload_build_context(client, nb, build_dir,
+                             progress=lambda m: print(f"  {m}"))
+    else:
+        client.apply(nb, "rbt-cli")
+    if nb["spec"].get("suspend"):
+        nb["spec"]["suspend"] = False
+        client.apply(nb, "rbt-cli")
+    print(f"notebooks/{ko.name(nb)} applied; waiting for readiness…")
+    if not wait_ready(client, nb, args.timeout):
+        return 1
+    pod = f"{ko.name(nb)}-notebook"
+    if args.sync:
+        from runbooks_tpu.utils.sync import start_sync
+
+        start_sync(pod, args.namespace, context_dir(args.filename))
+    print(f"open http://localhost:8888?token=default")
+    return _kubectl_port_forward(f"pod/{pod}", 8888, 8888, args.namespace)
+
+
+def cmd_suspend(args) -> int:
+    client = make_client(args)
+    kind, name = parse_scope(args.scope)
+    if kind != "Notebook" or not name:
+        raise SystemExit("usage: rbt suspend notebooks/<name>")
+    client.apply({"apiVersion": API_VERSION, "kind": "Notebook",
+                  "metadata": {"name": name, "namespace": args.namespace},
+                  "spec": {"suspend": True}}, "rbt-cli")
+    print(f"notebooks/{name} suspended")
+    return 0
+
+
+def _kubectl_port_forward(target: str, local: int, remote: int,
+                          namespace: str) -> int:
+    cmd = ["kubectl", "port-forward", "-n", namespace, target,
+           f"{local}:{remote}"]
+    backoff = 1.0
+    for attempt in range(6):
+        try:
+            rc = subprocess.call(cmd)
+        except FileNotFoundError:
+            print("kubectl not found on PATH (needed for port-forward)",
+                  file=sys.stderr)
+            return 1
+        except KeyboardInterrupt:
+            return 0
+        if rc == 0:
+            return 0
+        print(f"port-forward exited ({rc}); retrying in {backoff:.0f}s",
+              file=sys.stderr)
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 30)
+    print(f"port-forward to {target} kept failing; giving up",
+          file=sys.stderr)
+    return 1
+
+
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="rbt",
+                                description="runbooks-tpu dev CLI")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--kubeconfig")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp, filename=True):
+        if filename:
+            sp.add_argument("-f", "--filename", default=".")
+        sp.add_argument("--timeout", type=float, default=720.0)
+        sp.add_argument("--build", help="build-context dir to upload")
+
+    sp = sub.add_parser("apply", help="apply manifests (with upload builds)")
+    common(sp)
+    sp.add_argument("--wait", action="store_true")
+    sp.set_defaults(func=cmd_apply)
+
+    sp = sub.add_parser("get", help="list resources with conditions")
+    sp.add_argument("scope", nargs="?", default="")
+    sp.set_defaults(func=cmd_get)
+
+    sp = sub.add_parser("delete", help="delete resources")
+    sp.add_argument("scope", nargs="?", default="")
+    sp.add_argument("-f", "--filename")
+    sp.set_defaults(func=cmd_delete)
+
+    sp = sub.add_parser("run", help="create-with-upload and wait")
+    common(sp)
+    group = sp.add_mutually_exclusive_group()
+    group.add_argument("-i", "--increment", action="store_true")
+    group.add_argument("-r", "--replace", action="store_true")
+    sp.set_defaults(func=cmd_run)
+
+    sp = sub.add_parser("serve", help="port-forward a ready Server")
+    sp.add_argument("scope")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--timeout", type=float, default=720.0)
+    sp.set_defaults(func=cmd_serve)
+
+    sp = sub.add_parser("notebook", help="notebook dev loop")
+    common(sp)
+    sp.add_argument("--no-sync", dest="sync", action="store_false")
+    sp.set_defaults(func=cmd_notebook)
+
+    sp = sub.add_parser("suspend", help="suspend a notebook")
+    sp.add_argument("scope")
+    sp.set_defaults(func=cmd_suspend)
+    return p
 
 
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    sys.stderr.write(
-        "rbt: CLI subcommands (apply/run/serve/get/delete/notebook) are "
-        "under construction in this round.\n"
-    )
-    return 2
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except KeyboardInterrupt:
+        return 130
 
 
 if __name__ == "__main__":

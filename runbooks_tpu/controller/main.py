@@ -1,0 +1,114 @@
+"""Controller-manager entrypoint.
+
+Reference analog: cmd/controllermanager/main.go — build the client, pick the
+cloud (env CLOUD with metadata auto-detection), dial SCI over gRPC, register
+the reconcilers, serve health probes, run the watch loops.
+
+Run: ``python -m runbooks_tpu.controller.main``. Env:
+  CLOUD=local|gcp        cloud flavor (default local)
+  SCI_ADDRESS            gRPC address (default sci.runbooks-tpu.svc:10080;
+                         "fake" for the in-process no-op client)
+  CLUSTER_NAME, ARTIFACT_BUCKET_URL, REGISTRY_URL, PRINCIPAL
+  HEALTH_PORT            readiness/liveness HTTP (default 8081)
+  STANDALONE=1           use the in-memory fake cluster (demo/smoke)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+
+def build_ctx():
+    from runbooks_tpu.cloud.base import CommonConfig
+    from runbooks_tpu.controller.manager import Ctx
+
+    common = CommonConfig.from_env()
+    cloud_name = os.environ.get("CLOUD", "local")
+    if cloud_name == "gcp":
+        from runbooks_tpu.cloud.gcp import GCPCloud, GCPConfig
+
+        cloud = GCPCloud(GCPConfig(common=common,
+                                   project_id=os.environ.get("PROJECT_ID",
+                                                             "")))
+    else:
+        from runbooks_tpu.cloud.local import LocalCloud
+
+        cloud = LocalCloud(common)
+
+    sci_address = os.environ.get("SCI_ADDRESS",
+                                 "sci.runbooks-tpu.svc.cluster.local:10080")
+    if sci_address == "fake":
+        from runbooks_tpu.sci.base import FakeSCI
+
+        sci = FakeSCI()
+    else:
+        from runbooks_tpu.sci.grpc_service import GrpcSCI
+
+        sci = GrpcSCI(sci_address)
+
+    if os.environ.get("STANDALONE"):
+        from runbooks_tpu.k8s.fake import FakeCluster
+
+        client = FakeCluster()
+    else:
+        from runbooks_tpu.k8s.client import K8sClient
+
+        client = K8sClient()
+    return Ctx(client=client, cloud=cloud, sci=sci)
+
+
+def make_manager(ctx):
+    from runbooks_tpu.controller.build import BuildReconciler
+    from runbooks_tpu.controller.dataset import DatasetReconciler
+    from runbooks_tpu.controller.manager import Manager
+    from runbooks_tpu.controller.model import ModelReconciler
+    from runbooks_tpu.controller.notebook import NotebookReconciler
+    from runbooks_tpu.controller.server import ServerReconciler
+
+    return Manager(ctx, [
+        BuildReconciler("Model"), BuildReconciler("Dataset"),
+        BuildReconciler("Server"), BuildReconciler("Notebook"),
+        ModelReconciler(), DatasetReconciler(),
+        ServerReconciler(), NotebookReconciler(),
+    ])
+
+
+class _Health(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path in ("/healthz", "/readyz"):
+            body = json.dumps({"ok": True}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *args):  # silence request logging
+        return
+
+
+def main() -> int:
+    ctx = build_ctx()
+    mgr = make_manager(ctx)
+
+    health_port = int(os.environ.get("HEALTH_PORT", "8081"))
+    httpd = HTTPServer(("0.0.0.0", health_port), _Health)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    print(f"controller-manager: cloud={ctx.cloud.name} "
+          f"health=:{health_port}", flush=True)
+    stop = threading.Event()
+    try:
+        mgr.run(stop)
+    except KeyboardInterrupt:
+        stop.set()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,147 @@
+"""gRPC server + client for the SCI service.
+
+The service definition lives in sci.proto; message classes come from
+``protoc --python_out`` (sci_pb2). The image has no grpc_tools codegen
+plugin, so the service/stub layer is hand-written against grpcio's generic
+handler API — wire-compatible with what protoc-gen-grpc would emit (same
+method paths ``/runbooks_tpu.sci.Controller/<Method>``, same protobuf
+serialization).
+
+Reference analogs: the gRPC server mains under cmd/sci-* and the client dial
+in cmd/controllermanager/main.go.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from runbooks_tpu.sci import sci_pb2
+from runbooks_tpu.sci.base import DEFAULT_EXPIRY_SECONDS, SCIClient
+
+SERVICE = "runbooks_tpu.sci.Controller"
+DEFAULT_PORT = 10080
+
+_METHODS = {
+    "CreateSignedURL": (sci_pb2.CreateSignedURLRequest,
+                        sci_pb2.CreateSignedURLResponse),
+    "GetObjectMd5": (sci_pb2.GetObjectMd5Request,
+                     sci_pb2.GetObjectMd5Response),
+    "BindIdentity": (sci_pb2.BindIdentityRequest,
+                     sci_pb2.BindIdentityResponse),
+    "EnsureTPUNodePool": (sci_pb2.EnsureTPUNodePoolRequest,
+                          sci_pb2.EnsureTPUNodePoolResponse),
+}
+
+
+class _Servicer:
+    """Adapts an in-process SCIClient implementation to the RPC surface."""
+
+    def __init__(self, impl: SCIClient):
+        self.impl = impl
+
+    def CreateSignedURL(self, request, context):
+        url = self.impl.create_signed_url(
+            request.bucket_name, request.object_name,
+            int(request.expiration_seconds) or DEFAULT_EXPIRY_SECONDS,
+            request.md5_checksum)
+        return sci_pb2.CreateSignedURLResponse(url=url)
+
+    def GetObjectMd5(self, request, context):
+        md5 = self.impl.get_object_md5(request.bucket_name,
+                                       request.object_name)
+        if md5 is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"object {request.object_name} not found")
+        return sci_pb2.GetObjectMd5Response(md5_checksum=md5)
+
+    def BindIdentity(self, request, context):
+        self.impl.bind_identity(
+            principal=request.principal,
+            ksa=request.kubernetes_service_account,
+            namespace=request.kubernetes_namespace)
+        return sci_pb2.BindIdentityResponse()
+
+    def EnsureTPUNodePool(self, request, context):
+        ensure = getattr(self.impl, "ensure_tpu_node_pool", None)
+        if ensure is None:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "node-pool provisioning not supported by this SCI")
+        name, created = ensure(request.tpu_type, request.topology,
+                               request.spot)
+        return sci_pb2.EnsureTPUNodePoolResponse(node_pool_name=name,
+                                                 created=created)
+
+
+def serve(impl: SCIClient, port: int = DEFAULT_PORT,
+          max_workers: int = 8) -> grpc.Server:
+    """Start (and return) a gRPC server exposing `impl`. Caller stops it."""
+    servicer = _Servicer(impl)
+    handlers = {}
+    for method, (req_cls, resp_cls) in _METHODS.items():
+        handlers[method] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, method),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+    server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    return server
+
+
+class GrpcSCI:
+    """SCIClient implementation backed by a remote SCI gRPC service (what
+    the controller manager dials; reference:
+    cmd/controllermanager/main.go grpc.Dial)."""
+
+    def __init__(self, address: str = f"localhost:{DEFAULT_PORT}",
+                 timeout: float = 30.0):
+        self.channel = grpc.insecure_channel(address)
+        self.timeout = timeout
+
+    def _call(self, method: str, request, resp_cls):
+        callable_ = self.channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=type(request).SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+        return callable_(request, timeout=self.timeout)
+
+    def create_signed_url(self, bucket_name, object_name,
+                          expiration_seconds=DEFAULT_EXPIRY_SECONDS,
+                          md5_checksum=""):
+        resp = self._call("CreateSignedURL", sci_pb2.CreateSignedURLRequest(
+            bucket_name=bucket_name, object_name=object_name,
+            expiration_seconds=expiration_seconds,
+            md5_checksum=md5_checksum), sci_pb2.CreateSignedURLResponse)
+        return resp.url
+
+    def get_object_md5(self, bucket_name, object_name) -> Optional[str]:
+        try:
+            resp = self._call("GetObjectMd5", sci_pb2.GetObjectMd5Request(
+                bucket_name=bucket_name, object_name=object_name),
+                sci_pb2.GetObjectMd5Response)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return None
+            raise
+        return resp.md5_checksum
+
+    def bind_identity(self, principal, ksa, namespace):
+        self._call("BindIdentity", sci_pb2.BindIdentityRequest(
+            principal=principal, kubernetes_service_account=ksa,
+            kubernetes_namespace=namespace), sci_pb2.BindIdentityResponse)
+
+    def ensure_tpu_node_pool(self, tpu_type: str, topology: str,
+                             spot: bool = False):
+        resp = self._call("EnsureTPUNodePool",
+                          sci_pb2.EnsureTPUNodePoolRequest(
+                              tpu_type=tpu_type, topology=topology,
+                              spot=spot),
+                          sci_pb2.EnsureTPUNodePoolResponse)
+        return resp.node_pool_name, resp.created

@@ -1,0 +1,58 @@
+"""Local signed-URL upload endpoint: HTTP PUT -> filesystem bucket + md5.
+
+The local SCI's "signed URLs" point here (reference analog: the kind SCI's
+HTTP PUT handler writing body + md5 sidecar to local disk —
+internal/sci/kind/server.go). Runs alongside the gRPC service in
+``python -m runbooks_tpu.sci.main``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+from aiohttp import web
+
+from runbooks_tpu.sci.base import LocalSCI
+
+
+def create_app(sci: LocalSCI) -> web.Application:
+    app = web.Application(client_max_size=10 * 1024 ** 3)
+
+    async def put_object(request: web.Request) -> web.Response:
+        expiry = request.query.get("expiry")
+        if expiry and int(expiry) < time.time():
+            return web.json_response(
+                {"error": "signed URL expired"}, status=403)
+        path = request.match_info["path"]
+        if "/" not in path:
+            return web.json_response(
+                {"error": "path must be bucket/object"}, status=400)
+        bucket, object_name = path.split("/", 1)
+        data = await request.read()
+        md5 = sci.put_object(bucket, object_name, data)
+        want = request.headers.get("Content-MD5", "")
+        if want:
+            # Standard Content-MD5 is base64(digest); accept hex too.
+            try:
+                want_hex = (want if len(want) == 32 and
+                            all(c in "0123456789abcdef" for c in want.lower())
+                            else __import__("base64").b64decode(want).hex())
+            except Exception:
+                want_hex = ""
+            if want_hex != md5:
+                return web.json_response(
+                    {"error": f"md5 mismatch: body {md5} != header {want}"},
+                    status=400)
+        return web.json_response({"md5": md5, "bytes": len(data)})
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    app.router.add_put("/{path:.+}", put_object)
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
+def run(sci: LocalSCI, port: int = 30080) -> None:
+    web.run_app(create_app(sci), port=port, print=lambda *a: None)

@@ -1,0 +1,66 @@
+"""SCI server entrypoint: gRPC (+ local HTTP upload endpoint).
+
+Flavor selection mirrors the reference's per-cloud SCI binaries (reference:
+cmd/sci-gcp, cmd/sci-kind, cmd/sci-aws) collapsed into one entrypoint:
+
+  SCI_FLAVOR=local  (default) — filesystem bucket + HTTP PUT endpoint
+  SCI_FLAVOR=gcp              — GCS signing + IAM workload-identity binding
+                                (requires google-cloud SDKs in the image)
+
+Env: SCI_PORT (gRPC, default 10080), SCI_HTTP_PORT (local uploads, 30080),
+SCI_BUCKET_ROOT (local bucket dir), SCI_ENDPOINT (URL prefix for local
+signed URLs).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+def main() -> int:
+    flavor = os.environ.get("SCI_FLAVOR", "local")
+    grpc_port = int(os.environ.get("SCI_PORT", "10080"))
+
+    if flavor == "local":
+        from runbooks_tpu.sci.base import LocalSCI
+        from runbooks_tpu.sci.http_endpoint import run as run_http
+
+        http_port = int(os.environ.get("SCI_HTTP_PORT", "30080"))
+        # Root "/" makes file:///bucket/... artifact URLs map 1:1 onto disk
+        # (the "bucket" is the first path component of the URL).
+        impl = LocalSCI(
+            root=os.environ.get("SCI_BUCKET_ROOT", "/"),
+            endpoint=os.environ.get("SCI_ENDPOINT",
+                                    f"http://localhost:{http_port}"))
+        from runbooks_tpu.sci.grpc_service import serve
+
+        server = serve(impl, port=grpc_port)
+        print(f"sci[local]: grpc :{grpc_port}, http :{http_port}, "
+              f"bucket {impl.root}", flush=True)
+        try:
+            run_http(impl, port=http_port)  # blocks
+        finally:
+            server.stop(grace=2)
+        return 0
+
+    if flavor == "gcp":
+        from runbooks_tpu.sci.gcp import GCPSCI
+        from runbooks_tpu.sci.grpc_service import serve
+
+        impl = GCPSCI.auto_configure()
+        server = serve(impl, port=grpc_port)
+        print(f"sci[gcp]: grpc :{grpc_port}", flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop(grace=2)
+        return 0
+
+    raise SystemExit(f"unknown SCI_FLAVOR {flavor!r}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,89 @@
+"""Notebook file sync: mirror /content changes in the pod back to the
+workstation.
+
+Reference behavior mirrored (reference: internal/client/sync.go +
+containertools/cmd/nbwatch): copy the nbwatch binary into the pod
+(kubectl cp), exec it, stream its JSON events, and for each changed file
+kubectl-cp it back (delete locally on REMOVE/RENAME). The watcher itself is
+the native C++ tool in native/nbwatch (built per-arch; inside the workload
+images it ships at /usr/local/bin/nbwatch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+from typing import Optional
+
+NBWATCH_LOCAL = os.path.join(os.path.dirname(__file__), "..", "..",
+                             "native", "nbwatch", "nbwatch")
+NBWATCH_REMOTE = "/tmp/nbwatch"
+CONTENT_ROOT = "/content"
+
+
+def _kubectl(*args: str, **kwargs):
+    return subprocess.run(["kubectl", *args], check=True, **kwargs)
+
+
+def copy_from_pod(pod: str, namespace: str, remote_path: str,
+                  local_path: str) -> None:
+    os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+    _kubectl("cp", "-n", namespace, f"{pod}:{remote_path.lstrip('/')}",
+             local_path)
+
+
+def copy_to_pod(pod: str, namespace: str, local_path: str,
+                remote_path: str) -> None:
+    _kubectl("cp", "-n", namespace, local_path,
+             f"{pod}:{remote_path.lstrip('/')}")
+
+
+def start_sync(pod: str, namespace: str, local_dir: str,
+               nbwatch_path: Optional[str] = None) -> threading.Thread:
+    """Start the sync loop in a daemon thread; returns the thread."""
+
+    def run():
+        binary = nbwatch_path or os.path.abspath(NBWATCH_LOCAL)
+        try:
+            if os.path.exists(binary):
+                copy_to_pod(pod, namespace, binary, NBWATCH_REMOTE)
+                _kubectl("exec", "-n", namespace, pod, "--", "chmod", "+x",
+                         NBWATCH_REMOTE)
+                watcher_cmd = NBWATCH_REMOTE
+            else:
+                # Image ships its own (workload images install it).
+                watcher_cmd = "nbwatch"
+            proc = subprocess.Popen(
+                ["kubectl", "exec", "-n", namespace, pod, "--",
+                 watcher_cmd, CONTENT_ROOT],
+                stdout=subprocess.PIPE, text=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            print(f"sync: disabled ({e})")
+            return
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            rel = os.path.relpath(event["path"], CONTENT_ROOT)
+            local_path = os.path.join(local_dir, rel)
+            try:
+                if event["op"] in ("REMOVE", "RENAME"):
+                    if os.path.exists(local_path):
+                        os.remove(local_path)
+                        print(f"sync: removed {rel}")
+                else:
+                    copy_from_pod(pod, namespace, event["path"], local_path)
+                    print(f"sync: pulled {rel}")
+            except subprocess.CalledProcessError:
+                print(f"sync: failed to mirror {rel}")
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    return thread

@@ -1,0 +1,154 @@
+"""CLI tests: apply/get/delete/run against the fake cluster, plus the upload
+handshake client-side flow."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from runbooks_tpu.api.types import API_VERSION, Model
+from runbooks_tpu.cli import main as cli
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.k8s.fake import FakeCluster
+
+
+@pytest.fixture()
+def fake(monkeypatch):
+    cluster = FakeCluster()
+    monkeypatch.setattr(cli, "make_client", lambda args: cluster)
+    return cluster
+
+
+def write_manifests(tmp_path):
+    (tmp_path / "stack.yaml").write_text("""
+apiVersion: runbooks-tpu.dev/v1
+kind: Server
+metadata: {name: srv}
+spec: {image: s, model: {name: m1}}
+---
+apiVersion: runbooks-tpu.dev/v1
+kind: Model
+metadata: {name: m1}
+spec: {image: trainer}
+---
+apiVersion: v1
+kind: ConfigMap
+metadata: {name: ignored}
+""")
+    return str(tmp_path / "stack.yaml")
+
+
+def test_apply_get_delete(tmp_path, fake, capsys):
+    path = write_manifests(tmp_path)
+    assert cli.main(["apply", "-f", path]) == 0
+    out = capsys.readouterr().out
+    # dependency-friendly order: Model before Server
+    assert out.index("Model/m1") < out.index("Server/srv")
+    assert fake.get(API_VERSION, "Model", "default", "m1") is not None
+    assert fake.get(API_VERSION, "Server", "default", "srv") is not None
+
+    assert cli.main(["get", ""]) == 0
+    out = capsys.readouterr().out
+    assert "models/m1" in out and "servers/srv" in out
+
+    assert cli.main(["get", "models/m1"]) == 0
+    out = capsys.readouterr().out
+    assert "models/m1" in out and "servers/srv" not in out
+
+    assert cli.main(["delete", "models/m1"]) == 0
+    assert fake.get(API_VERSION, "Model", "default", "m1") is None
+    assert cli.main(["delete", "-f", path]) == 0
+    assert fake.get(API_VERSION, "Server", "default", "srv") is None
+
+
+def test_run_auto_increment(tmp_path, fake):
+    (tmp_path / "job.yaml").write_text("""
+apiVersion: runbooks-tpu.dev/v1
+kind: Model
+metadata: {name: exp}
+spec: {image: trainer}
+""")
+    fake.create(Model.new("exp").obj)
+    fake.create(Model.new("exp-3").obj)
+
+    def make_ready_soon():
+        for _ in range(100):
+            obj = fake.get(API_VERSION, "Model", "default", "exp-4")
+            if obj:
+                obj.setdefault("status", {})["ready"] = True
+                fake.update_status(obj)
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=make_ready_soon, daemon=True)
+    t.start()
+    rc = cli.main(["run", "-f", str(tmp_path / "job.yaml"), "-i",
+                   "--timeout", "10"])
+    assert rc == 0
+    assert fake.get(API_VERSION, "Model", "default", "exp-4") is not None
+
+
+def test_upload_build_context(tmp_path, fake):
+    from runbooks_tpu.utils.upload import upload_build_context
+
+    src = tmp_path / "ctx"
+    src.mkdir()
+    (src / "Dockerfile").write_text("FROM scratch\n")
+    (src / "train.py").write_text("print('hi')\n")
+
+    obj = Model.new("up", spec={"build": {"upload": {}}}).obj
+    fake.create(obj)
+
+    uploaded = {}
+
+    def fake_controller():
+        # Play the build reconciler's part: watch for the requestID, publish
+        # a signed URL.
+        for _ in range(200):
+            cur = fake.get(API_VERSION, "Model", "default", "up")
+            req_id = ko.deep_get(cur, "spec", "build", "upload", "requestID")
+            if req_id:
+                ko.deep_set(cur, {"signedURL": "http://127.0.0.1:1/unused",
+                                  "requestID": req_id,
+                                  "expiration": int(time.time()) + 300},
+                            "status", "buildUpload")
+                fake.update_status(cur)
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=fake_controller, daemon=True)
+    t.start()
+
+    import runbooks_tpu.utils.upload as up
+
+    def fake_put(url, data, md5):
+        uploaded["url"], uploaded["bytes"], uploaded["md5"] = \
+            url, len(data), md5
+
+    orig = up.put_signed_url
+    up.put_signed_url = fake_put
+    try:
+        result = upload_build_context(fake, obj, str(src), timeout_s=10)
+    finally:
+        up.put_signed_url = orig
+
+    assert uploaded["bytes"] > 0
+    assert ko.deep_get(result, "spec", "build", "upload", "md5checksum") == \
+        uploaded["md5"]
+    assert ko.annotations(result).get(
+        "runbooks-tpu.dev/upload-timestamp")
+
+
+def test_upload_requires_dockerfile(tmp_path):
+    from runbooks_tpu.utils.upload import prepare_image_tarball
+
+    with pytest.raises(FileNotFoundError):
+        prepare_image_tarball(str(tmp_path))
+
+
+def test_parse_scope_errors():
+    with pytest.raises(SystemExit):
+        cli.parse_scope("frobs/x")
+    assert cli.parse_scope("models/m") == ("Model", "m")
+    assert cli.parse_scope("datasets") == ("Dataset", None)
