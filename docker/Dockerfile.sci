@@ -1,0 +1,15 @@
+# SCI image (reference analog: Dockerfile.sci-gcp / Dockerfile.sci-kind).
+# SCI_FLAVOR selects local|gcp at runtime.
+FROM python:3.12-slim
+
+RUN pip install --no-cache-dir grpcio protobuf aiohttp pyyaml \
+    google-cloud-storage google-api-python-client || \
+    pip install --no-cache-dir grpcio protobuf aiohttp pyyaml
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY runbooks_tpu ./runbooks_tpu
+RUN pip install --no-cache-dir --no-deps -e .
+
+EXPOSE 10080 30080
+ENTRYPOINT ["python", "-m", "runbooks_tpu.sci.main"]

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# GKE install: cluster + TPU node pools + bucket + registry + identity +
+# operator. Reference analog: install/gcp/up.sh (which provisioned L4 GPU
+# pools; here the pools are TPU slices and further pools are provisioned
+# on demand by the SCI EnsureTPUNodePool RPC).
+set -euo pipefail
+
+: "${PROJECT_ID:?set PROJECT_ID}"
+REGION="${REGION:-us-central2}"
+ZONE="${ZONE:-us-central2-b}"
+CLUSTER="${CLUSTER:-runbooks-tpu}"
+BUCKET="${BUCKET:-${PROJECT_ID}-runbooks-tpu}"
+REPO="${REPO:-runbooks-tpu}"
+GSA="runbooks-tpu@${PROJECT_ID}.iam.gserviceaccount.com"
+
+gcloud container clusters create "$CLUSTER" \
+  --project "$PROJECT_ID" --zone "$ZONE" \
+  --release-channel rapid \
+  --workload-pool "${PROJECT_ID}.svc.id.goog" \
+  --addons GcsFuseCsiDriver \
+  --num-nodes 2 --machine-type e2-standard-4
+
+# A starter single-host v5e pool; multi-host pools are created on demand via
+# the SCI EnsureTPUNodePool RPC when a topology needs them.
+gcloud container node-pools create tpu-v5e-2x4 \
+  --project "$PROJECT_ID" --zone "$ZONE" --cluster "$CLUSTER" \
+  --machine-type ct5lp-hightpu-8t --num-nodes 1 --spot || true
+
+gsutil mb -p "$PROJECT_ID" -l "$REGION" "gs://${BUCKET}" || true
+gcloud artifacts repositories create "$REPO" --project "$PROJECT_ID" \
+  --location "$REGION" --repository-format docker || true
+
+gcloud iam service-accounts create runbooks-tpu --project "$PROJECT_ID" || true
+gsutil iam ch "serviceAccount:${GSA}:roles/storage.admin" "gs://${BUCKET}"
+gcloud artifacts repositories add-iam-policy-binding "$REPO" \
+  --project "$PROJECT_ID" --location "$REGION" \
+  --member "serviceAccount:${GSA}" --role roles/artifactregistry.admin
+# SCI needs to sign URLs as the GSA and manage WI bindings on it.
+gcloud iam service-accounts add-iam-policy-binding "$GSA" \
+  --project "$PROJECT_ID" \
+  --member "serviceAccount:${GSA}" --role roles/iam.serviceAccountTokenCreator
+gcloud iam service-accounts add-iam-policy-binding "$GSA" \
+  --project "$PROJECT_ID" \
+  --member "serviceAccount:${PROJECT_ID}.svc.id.goog[runbooks-tpu/sci]" \
+  --role roles/iam.workloadIdentityUser
+
+gcloud container clusters get-credentials "$CLUSTER" \
+  --project "$PROJECT_ID" --zone "$ZONE"
+
+kubectl apply -f config/crd/
+kubectl apply -f config/manager/manager.yaml
+kubectl apply -f config/rbac/role.yaml
+kubectl apply -f config/sci/deployment.yaml
+kubectl create configmap system -n runbooks-tpu \
+  --from-literal CLOUD=gcp \
+  --from-literal CLUSTER_NAME="$CLUSTER" \
+  --from-literal PROJECT_ID="$PROJECT_ID" \
+  --from-literal ARTIFACT_BUCKET_URL="gs://${BUCKET}" \
+  --from-literal REGISTRY_URL="${REGION}-docker.pkg.dev/${PROJECT_ID}/${REPO}" \
+  --from-literal PRINCIPAL="$GSA" \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+echo "done — try: rbt apply -f examples/facebook-opt-125m --wait"

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Local (kind or any dev cluster) install — the zero-cloud-deps loop
+# (reference analog: install/kind/up.sh + the kind cloud/SCI pair).
+set -euo pipefail
+
+if command -v kind >/dev/null && ! kind get clusters | grep -q runbooks-tpu; then
+  cat <<'EOF' | kind create cluster --name runbooks-tpu --config -
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+nodes:
+  - role: control-plane
+    extraPortMappings:
+      - containerPort: 30080   # local SCI signed-URL PUT endpoint
+        hostPort: 30080
+EOF
+fi
+
+kubectl apply -f config/crd/
+kubectl apply -f config/manager/manager.yaml
+kubectl apply -f config/rbac/role.yaml
+kubectl apply -f config/sci/deployment.yaml
+kubectl create configmap system -n runbooks-tpu \
+  --from-literal CLOUD=local \
+  --from-literal CLUSTER_NAME=local \
+  --from-literal ARTIFACT_BUCKET_URL=file:///bucket \
+  --from-literal REGISTRY_URL=localhost:5000 \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+echo "done — try: rbt apply -f examples/facebook-opt-125m --wait"

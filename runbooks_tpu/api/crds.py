@@ -1,0 +1,170 @@
+"""CRD manifest generation for the four resources.
+
+The reference generates its CRDs with controller-gen (reference:
+config/crd/bases/*.yaml, Makefile `manifests` target); here the schemas are
+emitted programmatically: ``python -m runbooks_tpu.api.crds config/crd``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict
+
+import yaml
+
+from runbooks_tpu.api.types import GROUP, KINDS, VERSION
+
+
+def _obj_ref():
+    return {"type": "object",
+            "properties": {"name": {"type": "string"}},
+            "required": ["name"]}
+
+
+def _resources_schema() -> Dict:
+    return {
+        "type": "object",
+        "properties": {
+            "cpu": {"type": "integer", "default": 2},
+            "memory": {"type": "integer", "default": 10,
+                       "description": "GiB"},
+            "disk": {"type": "integer", "default": 10,
+                     "description": "GiB ephemeral"},
+            "spot": {"type": "boolean"},
+            "tpu": {
+                "type": "object",
+                "description": "Schedules onto a TPU pod slice; topologies "
+                               "spanning hosts fan out one pod per host.",
+                "properties": {
+                    "type": {"type": "string",
+                             "enum": ["v4", "v5e", "v5p", "v6e"]},
+                    "topology": {"type": "string",
+                                 "pattern": r"^\d+x\d+(x\d+)?$"},
+                },
+                "required": ["type", "topology"],
+            },
+        },
+    }
+
+
+def _build_schema() -> Dict:
+    return {
+        "type": "object",
+        "properties": {
+            "git": {
+                "type": "object",
+                "properties": {
+                    "url": {"type": "string"},
+                    "branch": {"type": "string"},
+                    "tag": {"type": "string"},
+                    "path": {"type": "string"},
+                },
+                "required": ["url"],
+            },
+            "upload": {
+                "type": "object",
+                "properties": {
+                    "md5checksum": {"type": "string",
+                                    "pattern": "^[a-f0-9]{32}$"},
+                    "requestID": {"type": "string"},
+                },
+            },
+        },
+    }
+
+
+def _common_spec() -> Dict:
+    return {
+        "image": {"type": "string"},
+        "build": _build_schema(),
+        "command": {"type": "array", "items": {"type": "string"}},
+        "env": {"type": "object",
+                "additionalProperties": {"type": "string"}},
+        "params": {"type": "object",
+                   "x-kubernetes-preserve-unknown-fields": True},
+        "resources": _resources_schema(),
+    }
+
+
+def _status_schema() -> Dict:
+    return {
+        "type": "object",
+        "properties": {
+            "ready": {"type": "boolean"},
+            "conditions": {
+                "type": "array",
+                "items": {"type": "object",
+                          "x-kubernetes-preserve-unknown-fields": True},
+            },
+            "artifacts": {"type": "object",
+                          "properties": {"url": {"type": "string"}}},
+            "buildUpload": {
+                "type": "object",
+                "properties": {
+                    "signedURL": {"type": "string"},
+                    "requestID": {"type": "string"},
+                    "expiration": {"type": "integer"},
+                    "storedMD5": {"type": "string"},
+                },
+            },
+        },
+    }
+
+
+def crd_for(kind: str) -> Dict:
+    spec_props = _common_spec()
+    if kind == "Model":
+        spec_props["model"] = _obj_ref()
+        spec_props["dataset"] = _obj_ref()
+    elif kind == "Server":
+        spec_props["model"] = _obj_ref()
+        spec_props["replicas"] = {"type": "integer", "default": 1}
+    elif kind == "Notebook":
+        spec_props["model"] = _obj_ref()
+        spec_props["dataset"] = _obj_ref()
+        spec_props["suspend"] = {"type": "boolean"}
+
+    plural = kind.lower() + "s"
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {"kind": kind, "listKind": f"{kind}List",
+                      "plural": plural, "singular": kind.lower()},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [{
+                    "name": "Ready", "type": "string",
+                    "jsonPath": ".status.ready",
+                }],
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {"type": "object",
+                                 "properties": spec_props},
+                        "status": _status_schema(),
+                    },
+                }},
+            }],
+        },
+    }
+
+
+def write_all(out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    for kind in KINDS:
+        path = os.path.join(out_dir, f"{GROUP}_{kind.lower()}s.yaml")
+        with open(path, "w") as f:
+            yaml.safe_dump(crd_for(kind), f, sort_keys=False)
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    write_all(sys.argv[1] if len(sys.argv) > 1 else "config/crd")

@@ -332,9 +332,11 @@ def cmd_suspend(args) -> int:
     kind, name = parse_scope(args.scope)
     if kind != "Notebook" or not name:
         raise SystemExit("usage: rbt suspend notebooks/<name>")
+    # Dedicated field manager owning only spec.suspend — applying with the
+    # manifest's manager would SSA-prune the rest of the spec.
     client.apply({"apiVersion": API_VERSION, "kind": "Notebook",
                   "metadata": {"name": name, "namespace": args.namespace},
-                  "spec": {"suspend": True}}, "rbt-cli")
+                  "spec": {"suspend": True}}, "rbt-cli-suspend")
     print(f"notebooks/{name} suspended")
     return 0
 

@@ -43,6 +43,10 @@ PLURALS = {
 }
 
 
+CLUSTER_SCOPED = {"Namespace", "CustomResourceDefinition", "ClusterRole",
+                  "ClusterRoleBinding", "Node", "PersistentVolume"}
+
+
 def plural(kind: str) -> str:
     return PLURALS.get(kind, kind.lower() + "s")
 
@@ -135,7 +139,7 @@ class K8sClient:
              name: Optional[str] = None, subresource: str = "",
              query: str = "") -> str:
         parts = [self.config.server, self._base_path(api_version)]
-        if namespace and kind != "Namespace":
+        if namespace and kind not in CLUSTER_SCOPED:
             parts.append(f"/namespaces/{namespace}")
         parts.append(f"/{plural(kind)}")
         if name:

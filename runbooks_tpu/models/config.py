@@ -171,8 +171,12 @@ CONFIGS = {
     "llama3-8b": _llama("llama3-8b", v=128256, h=4096, i=14336, l=32, q=32,
                         kv=8, d=128, s=8192, theta=500000.0),
     # Falcon family (reference: examples/falcon-7b-instruct, examples/falcon-40b)
-    "falcon-7b": _falcon("falcon-7b"),
-    "falcon-40b": _falcon("falcon-40b", h=8192, l=60, q=128, kv=8),
+    # 7b: multi-query (1 kv head), single shared layernorm per block;
+    # 40b: 8 kv groups, separate attn/mlp layernorms.
+    "falcon-7b": _falcon("falcon-7b", kv=1),
+    "falcon-40b": dataclasses.replace(
+        _falcon("falcon-40b", h=8192, l=60, q=128, kv=8),
+        shared_layer_norm=False),
     # OPT (reference: examples/facebook-opt-125m — the CPU smoke model)
     "opt-125m": _opt("opt-125m"),
     "opt-1.3b": _opt("opt-1.3b", h=2048, i=8192, l=24, q=32),

@@ -1,0 +1,81 @@
+"""Model-loader workload: import weights into the artifact store.
+
+The TPU-native replacement for the reference's external loader image
+(substratusai/model-loader-huggingface — reference: examples/
+facebook-opt-125m/base-model.yaml). Runs under the container contract:
+
+  params.json: {"model": "<config name>",
+                "source": "huggingface" | "dir" | "random",
+                "hf_name": "facebook/opt-125m",   # for source=huggingface
+                "dir": "/content/model"}          # for source=dir
+
+Writes an orbax checkpoint {"params": ...} + model.json metadata under
+/content/artifacts, which the trainer (as base model) and server mount and
+restore.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from runbooks_tpu.models.config import get_config
+from runbooks_tpu.models.convert import convert, load_torch_state_dict
+from runbooks_tpu.train.checkpoint import CheckpointManager
+from runbooks_tpu.utils import contract
+
+
+def load_weights(params_cfg: dict):
+    cfg = get_config(params_cfg.get("model", "debug"),
+                     **params_cfg.get("model_overrides", {}))
+    source = params_cfg.get("source", "random")
+    if source == "huggingface":
+        hf_name = params_cfg["hf_name"]
+        from huggingface_hub import snapshot_download  # ships w/ transformers
+
+        local_dir = snapshot_download(
+            hf_name, allow_patterns=["*.safetensors", "*.bin", "*.json",
+                                     "tokenizer*"])
+        state_dict = load_torch_state_dict(local_dir)
+        weights = convert(cfg, state_dict, dtype=cfg.param_dtype)
+    elif source == "dir":
+        model_dir = params_cfg.get("dir", contract.model_dir())
+        state_dict = load_torch_state_dict(model_dir)
+        weights = convert(cfg, state_dict, dtype=cfg.param_dtype)
+    elif source == "random":
+        from runbooks_tpu.models.transformer import init_params
+
+        weights = init_params(cfg, jax.random.key(
+            int(params_cfg.get("seed", 0))))
+    else:
+        raise ValueError(f"unknown source {source!r}")
+    return cfg, weights
+
+
+def main() -> int:
+    params_cfg = contract.load_params()
+    cfg, weights = load_weights(params_cfg)
+
+    artifacts = params_cfg.get("artifacts_dir") or contract.artifacts_dir()
+    os.makedirs(artifacts, exist_ok=True)
+    mgr = CheckpointManager(artifacts, async_save=False)
+    mgr.save(0, {"params": weights}, force=True)
+    mgr.wait()
+    mgr.close()
+
+    n_params = sum(int(np.prod(np.shape(x)))
+                   for x in jax.tree.leaves(weights))
+    meta = {"model": cfg.name, "num_params": n_params,
+            "vocab_size": cfg.vocab_size,
+            "source": params_cfg.get("source", "random")}
+    with open(os.path.join(artifacts, "model.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    print(json.dumps({"done": True, **meta}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,60 @@
+"""Multi-host slice bootstrap: turn operator-injected env into a JAX
+distributed runtime.
+
+The operator's fan-out (runbooks_tpu.cloud.resources) gives every pod in a
+slice `JAX_COORDINATOR_ADDRESS` / `JAX_NUM_PROCESSES` / `JAX_PROCESS_ID`
+(SURVEY.md §5.8 — the reference has no trainer rendezvous at all). Workloads
+call ``initialize()`` before first JAX use; single-host runs are a no-op, so
+every entrypoint can call it unconditionally.
+
+Multi-slice (DCN) training stacks MEGASCALE_* env on top — same call.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def env_process_info() -> Optional[dict]:
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if not (addr and num and pid):
+        return None
+    return {"coordinator_address": addr, "num_processes": int(num),
+            "process_id": int(pid)}
+
+
+_initialized = False
+
+
+def initialize(timeout_s: int = 300) -> bool:
+    """Initialize jax.distributed from the slice env. Returns True when a
+    multi-host runtime was formed, False for single-host (no-op)."""
+    global _initialized
+    if _initialized:
+        return True
+    info = env_process_info()
+    if info is None or info["num_processes"] <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=info["coordinator_address"],
+        num_processes=info["num_processes"],
+        process_id=info["process_id"],
+        initialization_timeout=timeout_s,
+    )
+    _initialized = True
+    return True
+
+
+def process_index() -> int:
+    info = env_process_info()
+    return info["process_id"] if info else 0
+
+
+def is_primary() -> bool:
+    """True on the process that should write checkpoints/metrics (host 0)."""
+    return process_index() == 0

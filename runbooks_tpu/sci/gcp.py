@@ -71,13 +71,20 @@ class GCPSCI:
                 "http://metadata.google.internal/computeMetadata/v1/"
                 "project/project-id",
                 headers={"Metadata-Flavor": "Google"})
+            last_err: Exception | None = None
             for attempt in range(5):  # workload-identity warm-up races
                 try:
                     project = urllib.request.urlopen(
                         req, timeout=3).read().decode()
                     break
-                except OSError:
+                except OSError as e:
+                    last_err = e
                     time.sleep(2 ** attempt)
+            if not project:
+                raise RuntimeError(
+                    "GCP SCI could not determine the project id: metadata "
+                    "server unreachable and PROJECT_ID unset"
+                ) from last_err
         return cls(
             project_id=project,
             cluster_name=env.get("CLUSTER_NAME", ""),
@@ -86,6 +93,23 @@ class GCPSCI:
         )
 
     # ------------------------------------------------------------------
+
+    def _signing_credentials(self):
+        """Credentials able to sign V4 URLs under workload identity, where
+        default compute credentials carry no private key: impersonate the
+        configured GSA so signing goes through IAMCredentials SignBlob
+        (reference: internal/sci/gcp/manager.go signs the same way)."""
+        auth = _require_google("google.auth")
+        creds, _ = auth.default()
+        if hasattr(creds, "sign_bytes"):
+            return creds
+        imp = _require_google("google.auth.impersonated_credentials")
+        return imp.Credentials(
+            source_credentials=creds,
+            target_principal=self.service_account,
+            target_scopes=["https://www.googleapis.com/auth/devstorage"
+                           ".read_write"],
+        )
 
     def create_signed_url(self, bucket_name: str, object_name: str,
                           expiration_seconds: int = DEFAULT_EXPIRY_SECONDS,
@@ -101,7 +125,7 @@ class GCPSCI:
         return blob.generate_signed_url(
             version="v4", method="PUT",
             expiration=expiration_seconds,
-            service_account_email=self.service_account or None,
+            credentials=self._signing_credentials(),
             **kwargs)
 
     def get_object_md5(self, bucket_name: str,

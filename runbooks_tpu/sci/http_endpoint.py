@@ -30,10 +30,12 @@ def create_app(sci: LocalSCI) -> web.Application:
                 {"error": "path must be bucket/object"}, status=400)
         bucket, object_name = path.split("/", 1)
         data = await request.read()
-        md5 = sci.put_object(bucket, object_name, data)
+        md5 = hashlib.md5(data).hexdigest()
         want = request.headers.get("Content-MD5", "")
         if want:
             # Standard Content-MD5 is base64(digest); accept hex too.
+            # Validate BEFORE storing so a corrupt body can never clobber a
+            # previously verified object.
             try:
                 want_hex = (want if len(want) == 32 and
                             all(c in "0123456789abcdef" for c in want.lower())
@@ -44,6 +46,7 @@ def create_app(sci: LocalSCI) -> web.Application:
                 return web.json_response(
                     {"error": f"md5 mismatch: body {md5} != header {want}"},
                     status=400)
+        sci.put_object(bucket, object_name, data)
         return web.json_response({"md5": md5, "bytes": len(data)})
 
     async def healthz(request: web.Request) -> web.Response:

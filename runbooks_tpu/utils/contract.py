@@ -18,13 +18,17 @@ import os
 import re
 from typing import Any, Dict, Optional
 
-CONTENT_DIR = os.environ.get("RBT_CONTENT_DIR", "/content")
 SERVE_PORT = 8080
 NOTEBOOK_PORT = 8888
 
 
+def content_dir() -> str:
+    # Read dynamically so tests/tools can repoint /content via env.
+    return os.environ.get("RBT_CONTENT_DIR", "/content")
+
+
 def content_path(*parts: str) -> str:
-    return os.path.join(CONTENT_DIR, *parts)
+    return os.path.join(content_dir(), *parts)
 
 
 def data_dir() -> str:

@@ -29,15 +29,15 @@ def _kubectl(*args: str, **kwargs):
 
 def copy_from_pod(pod: str, namespace: str, remote_path: str,
                   local_path: str) -> None:
+    # Absolute pod paths: stripping the slash would resolve against the
+    # container's workdir (/app), not the filesystem root.
     os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
-    _kubectl("cp", "-n", namespace, f"{pod}:{remote_path.lstrip('/')}",
-             local_path)
+    _kubectl("cp", "-n", namespace, f"{pod}:{remote_path}", local_path)
 
 
 def copy_to_pod(pod: str, namespace: str, local_path: str,
                 remote_path: str) -> None:
-    _kubectl("cp", "-n", namespace, local_path,
-             f"{pod}:{remote_path.lstrip('/')}")
+    _kubectl("cp", "-n", namespace, local_path, f"{pod}:{remote_path}")
 
 
 def start_sync(pod: str, namespace: str, local_dir: str,

@@ -103,4 +103,8 @@ def upload_build_context(client, obj: dict, src_dir: str,
         },
     }
     progress("upload complete")
-    return client.apply(nudge, "rbt-cli")
+    # Distinct field manager: under real SSA semantics, re-applying with the
+    # same manager that owns the full spec would prune every field omitted
+    # here (including build.upload). A dedicated manager owns only this
+    # annotation.
+    return client.apply(nudge, "rbt-cli-nudge")
