@@ -1,0 +1,97 @@
+"""Lease-based leader election for controller HA.
+
+Reference analog: controller-runtime's --leader-elect flag
+(cmd/controllermanager/main.go). Standard coordination.k8s.io Lease
+acquire/renew: the holder renews every `renew_s`; others take over when
+`lease_duration_s` passes without a renewal.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+from runbooks_tpu.k8s import objects as ko
+
+LEASE_API = "coordination.k8s.io/v1"
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000000Z", time.gmtime())
+
+
+def _parse(ts: str) -> float:
+    import calendar
+
+    try:
+        return calendar.timegm(time.strptime(ts.split(".")[0],
+                                             "%Y-%m-%dT%H:%M:%S"))
+    except (ValueError, AttributeError):
+        return 0.0
+
+
+class LeaderElector:
+    def __init__(self, client, name: str = "runbooks-tpu-controller",
+                 namespace: str = "runbooks-tpu",
+                 lease_duration_s: float = 15.0, renew_s: float = 5.0):
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.identity = f"{uuid.uuid4().hex[:12]}"
+        self.lease_duration_s = lease_duration_s
+        self.renew_s = renew_s
+        self.is_leader = threading.Event()
+        self._stop = threading.Event()
+
+    def _try_acquire(self) -> bool:
+        lease = self.client.get(LEASE_API, "Lease", self.namespace, self.name)
+        now = _now()
+        if lease is None:
+            try:
+                self.client.create({
+                    "apiVersion": LEASE_API, "kind": "Lease",
+                    "metadata": {"name": self.name,
+                                 "namespace": self.namespace},
+                    "spec": {"holderIdentity": self.identity,
+                             "leaseDurationSeconds":
+                                 int(self.lease_duration_s),
+                             "renewTime": now},
+                })
+                return True
+            except Exception:
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        renew = _parse(spec.get("renewTime", ""))
+        expired = time.time() - renew > self.lease_duration_s
+        if holder != self.identity and not expired:
+            return False
+        spec.update({"holderIdentity": self.identity, "renewTime": now})
+        try:
+            self.client.update(lease)
+            return True
+        except Exception:  # conflict: someone else renewed first
+            return False
+
+    def run(self) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                if self._try_acquire():
+                    if not self.is_leader.is_set():
+                        print(f"leader-election: acquired lease as "
+                              f"{self.identity}", flush=True)
+                    self.is_leader.set()
+                else:
+                    if self.is_leader.is_set():
+                        print("leader-election: lost lease", flush=True)
+                    self.is_leader.clear()
+                self._stop.wait(self.renew_s)
+
+        thread = threading.Thread(target=loop, daemon=True)
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -100,13 +100,46 @@ def main() -> int:
     httpd = HTTPServer(("0.0.0.0", health_port), _Health)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
+    from runbooks_tpu.controller.metrics import serve_metrics
+
+    metrics_port = int(os.environ.get("METRICS_PORT", "8080"))
+    serve_metrics(metrics_port)
+
+    elector = None
+    if os.environ.get("LEADER_ELECT", "").lower() in ("1", "true"):
+        from runbooks_tpu.controller.leader import LeaderElector
+
+        elector = LeaderElector(
+            ctx.client,
+            namespace=os.environ.get("POD_NAMESPACE", "runbooks-tpu"))
+        elector.run()
+
     print(f"controller-manager: cloud={ctx.cloud.name} "
-          f"health=:{health_port}", flush=True)
+          f"health=:{health_port} metrics=:{metrics_port} "
+          f"leader_elect={elector is not None}", flush=True)
     stop = threading.Event()
     try:
-        mgr.run(stop)
+        if elector is None:
+            mgr.run(stop)
+        else:
+            # Only the leaseholder reconciles; standbys idle until acquired.
+            while not stop.is_set():
+                if elector.is_leader.wait(timeout=1.0):
+                    leader_stop = threading.Event()
+
+                    def watch_leadership():
+                        while elector.is_leader.is_set() and \
+                                not stop.is_set():
+                            threading.Event().wait(0.5)
+                        leader_stop.set()
+
+                    threading.Thread(target=watch_leadership,
+                                     daemon=True).start()
+                    mgr.run(leader_stop)
     except KeyboardInterrupt:
         stop.set()
+        if elector is not None:
+            elector.stop()
     return 0
 
 

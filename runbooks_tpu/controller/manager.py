@@ -91,12 +91,17 @@ class Manager:
                     API_VERSION, kind, ko.namespace(obj), ko.name(obj))
                 if current is None:
                     continue
+                from runbooks_tpu.controller.metrics import REGISTRY
+
                 for rec in self.reconcilers[kind]:
                     try:
                         rec.reconcile(self.ctx, current)
+                        REGISTRY.inc("controller_reconcile_total", kind=kind)
                     except Exception:  # noqa: BLE001 — keep the loop alive
                         import traceback
 
+                        REGISTRY.inc("controller_reconcile_errors_total",
+                                     kind=kind)
                         traceback.print_exc()
             if time.monotonic() - last_resync > resync_seconds:
                 last_resync = time.monotonic()

@@ -1,0 +1,47 @@
+"""Leader election (Lease-based) + metrics registry tests."""
+
+import time
+
+from runbooks_tpu.controller.leader import LeaderElector
+from runbooks_tpu.controller.metrics import Registry
+from runbooks_tpu.k8s.fake import FakeCluster
+
+
+def test_single_elector_acquires():
+    client = FakeCluster()
+    e = LeaderElector(client, lease_duration_s=2.0, renew_s=0.1)
+    e.run()
+    assert e.is_leader.wait(timeout=3)
+    e.stop()
+
+
+def test_second_elector_waits_then_takes_over():
+    client = FakeCluster()
+    e1 = LeaderElector(client, lease_duration_s=1.0, renew_s=0.1)
+    e1.run()
+    assert e1.is_leader.wait(timeout=3)
+
+    e2 = LeaderElector(client, lease_duration_s=1.0, renew_s=0.1)
+    e2.run()
+    time.sleep(0.5)
+    assert not e2.is_leader.is_set()  # holder still renewing
+
+    e1.stop()  # leader dies; lease expires after lease_duration
+    deadline = time.time() + 5
+    while time.time() < deadline and not e2.is_leader.is_set():
+        time.sleep(0.1)
+    assert e2.is_leader.is_set()
+    e2.stop()
+
+
+def test_metrics_registry_renders_prometheus_text():
+    r = Registry()
+    r.inc("controller_reconcile_total", kind="Model")
+    r.inc("controller_reconcile_total", kind="Model")
+    r.inc("controller_reconcile_total", kind="Server")
+    r.set_gauge("queue_depth", 3, kind="Model")
+    text = r.render()
+    assert 'controller_reconcile_total{kind="Model"} 2.0' in text
+    assert 'controller_reconcile_total{kind="Server"} 1.0' in text
+    assert 'queue_depth{kind="Model"} 3' in text
+    assert "process_uptime_seconds" in text
