@@ -177,25 +177,48 @@ def _upload_dir_for(obj: dict, args) -> Optional[str]:
     return None
 
 
-def cmd_get(args) -> int:
-    client = make_client(args)
-    kind_filter, name_filter = parse_scope(args.scope)
+def _collect_rows(client, kind_filter, name_filter, namespace):
     rows = []
     for kind in KINDS:
         if kind_filter and kind != kind_filter:
             continue
-        for obj in client.list(API_VERSION, kind,
-                               namespace=args.namespace):
+        for obj in client.list(API_VERSION, kind, namespace=namespace):
             if name_filter and ko.name(obj) != name_filter:
                 continue
             ready = "True" if ko.deep_get(obj, "status", "ready") else "False"
             rows.append([f"{kind.lower()}s/{ko.name(obj)}",
                          ko.namespace(obj), ready, condition_summary(obj)])
-    if not rows:
-        print("no resources found")
+    return rows
+
+
+def cmd_get(args) -> int:
+    client = make_client(args)
+    kind_filter, name_filter = parse_scope(args.scope)
+    header = ["NAME", "NAMESPACE", "READY", "CONDITIONS"]
+    if not args.watch:
+        rows = _collect_rows(client, kind_filter, name_filter,
+                             args.namespace)
+        if not rows:
+            print("no resources found")
+            return 0
+        print_table(rows, header)
         return 0
-    print_table(rows, ["NAME", "NAMESPACE", "READY", "CONDITIONS"])
-    return 0
+    # Live watch view (the reference's `sub get` is a watch-based TUI table
+    # — internal/tui/get.go); redraw on change, ctrl-c to exit.
+    last = None
+    try:
+        while True:
+            rows = _collect_rows(client, kind_filter, name_filter,
+                                 args.namespace)
+            snapshot = json.dumps(rows)
+            if snapshot != last:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                print(time.strftime("%H:%M:%S"), "(watching — ctrl-c to exit)")
+                print_table(rows or [["(none)", "", "", ""]], header)
+                last = snapshot
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_delete(args) -> int:
@@ -388,6 +411,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("get", help="list resources with conditions")
     sp.add_argument("scope", nargs="?", default="")
+    sp.add_argument("-w", "--watch", action="store_true",
+                    help="live-updating table")
     sp.set_defaults(func=cmd_get)
 
     sp = sub.add_parser("delete", help="delete resources")

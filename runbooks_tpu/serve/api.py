@@ -139,10 +139,11 @@ class EngineWorker:
 
 def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   max_slots: int = 8,
-                  max_seq_len: Optional[int] = None) -> web.Application:
+                  max_seq_len: Optional[int] = None,
+                  mesh=None) -> web.Application:
     tokenizer = tokenizer or load_tokenizer(None)
     engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
-                             max_seq_len=max_seq_len)
+                             max_seq_len=max_seq_len, mesh=mesh)
     worker = EngineWorker(engine)
     app = web.Application()
     app["worker"] = worker
@@ -258,12 +259,30 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
 
 def main() -> int:
     params = contract.load_params()
+    # Multi-host slices: form the jax.distributed runtime before any JAX use.
+    from runbooks_tpu.parallel.distributed import initialize
+
+    initialize()
     cfg, model_params = load_model(params)
     tokenizer = load_tokenizer(params.get("tokenizer"))
+
+    # mesh_* params select sharded serving (e.g. mesh_tensor: 8 for TP).
+    mesh = None
+    import dataclasses as _dc
+
+    from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh_keys = {f.name for f in _dc.fields(MeshConfig)}
+    mesh_args = {k[len("mesh_"):]: int(v) for k, v in params.items()
+                 if k.startswith("mesh_") and k[len("mesh_"):] in mesh_keys}
+    if mesh_args:
+        mesh = make_mesh(MeshConfig(**mesh_args))
+
     app = create_server(
         cfg, model_params, tokenizer,
         max_slots=int(params.get("max_slots", 8)),
-        max_seq_len=params.get("max_seq_len"))
+        max_seq_len=params.get("max_seq_len"),
+        mesh=mesh)
     port = int(params.get("port", contract.SERVE_PORT))
     web.run_app(app, port=port, print=lambda *a: None)
     return 0

@@ -65,13 +65,53 @@ class InferenceEngine:
 
     def __init__(self, cfg: ModelConfig, params: Params, *,
                  max_slots: int = 8, max_seq_len: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0, mesh=None):
+        """mesh: optional jax.sharding.Mesh for sharded serving — params
+        shard by the model's logical axes (tensor parallelism over heads/
+        mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
+        and kv-heads over tensor. All jitted steps then run SPMD under the
+        mesh; XLA inserts the per-layer collectives."""
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            import contextlib
+
+            from runbooks_tpu.models.transformer import param_logical_axes
+            from runbooks_tpu.parallel.sharding import (
+                spec_for_array,
+                tree_shardings,
+            )
+            from jax.sharding import NamedSharding
+
+            params = jax.device_put(
+                params,
+                tree_shardings(jax.eval_shape(lambda: params),
+                               param_logical_axes(cfg), mesh))
+
+            def cache_sharding(shape):
+                spec = spec_for_array(
+                    shape, (None, "batch", None, "act_heads", None), mesh)
+                return NamedSharding(mesh, spec)
+
+            self._cache_sharding = cache_sharding
+            self._mesh_ctx = lambda: jax.set_mesh(mesh)
+        else:
+            self._cache_sharding = None
+            import contextlib
+
+            self._mesh_ctx = contextlib.nullcontext
         self.params = params
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.cache = KVCache.create(cfg, max_slots, self.max_seq_len,
                                     trash_slot=True)
+        if self._cache_sharding is not None:
+            self.cache = KVCache(
+                k=jax.device_put(self.cache.k,
+                                 self._cache_sharding(self.cache.k.shape)),
+                v=jax.device_put(self.cache.v,
+                                 self._cache_sharding(self.cache.v.shape)),
+                index=self.cache.index)
         self._pad_slot = self.max_seq_len  # trash slot index
         self.lengths = np.zeros(max_slots, np.int32)       # tokens in cache
         self.active = np.zeros(max_slots, bool)
@@ -136,6 +176,13 @@ class InferenceEngine:
         invalid, so reallocate, and clear all slot state."""
         self.cache = KVCache.create(self.cfg, self.max_slots,
                                     self.max_seq_len, trash_slot=True)
+        if self._cache_sharding is not None:
+            self.cache = KVCache(
+                k=jax.device_put(self.cache.k,
+                                 self._cache_sharding(self.cache.k.shape)),
+                v=jax.device_put(self.cache.v,
+                                 self._cache_sharding(self.cache.v.shape)),
+                index=self.cache.index)
         self.lengths[:] = 0
         self.active[:] = False
         self.last_token[:] = 0
@@ -171,9 +218,10 @@ class InferenceEngine:
         positions = np.full((1, bucket), self._pad_slot, np.int32)
         positions[0, :n] = np.arange(n)
 
-        logits, new_k, new_v = self._prefill(
-            self.params, self.cache.k, self.cache.v, jnp.asarray(padded),
-            jnp.asarray(positions), jnp.asarray(slot, jnp.int32))
+        with self._mesh_ctx():
+            logits, new_k, new_v = self._prefill(
+                self.params, self.cache.k, self.cache.v, jnp.asarray(padded),
+                jnp.asarray(positions), jnp.asarray(slot, jnp.int32))
         self.cache = KVCache(k=new_k, v=new_v, index=self.cache.index)
         # First generated token comes from the last *real* prompt position.
         self.rng, sub = jax.random.split(self.rng)
@@ -223,9 +271,10 @@ class InferenceEngine:
         top_ps = np.array([self.slot_req[i].top_p if self.active[i] else 1.0
                            for i in range(self.max_slots)], np.float32)
         self.rng, sub = jax.random.split(self.rng)
-        next_tok, self.cache = self._decode(
-            self.params, self.cache, tokens, jnp.asarray(positions), sub,
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps))
+        with self._mesh_ctx():
+            next_tok, self.cache = self._decode(
+                self.params, self.cache, tokens, jnp.asarray(positions), sub,
+                jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps))
         next_tok = np.asarray(next_tok)
         stepped = 0
         for slot in range(self.max_slots):

@@ -55,6 +55,11 @@ class TrainJobConfig:
     artifacts_dir: Optional[str] = None   # default: contract artifacts dir
     log_every: int = 10
     resume: bool = True
+    # XLA/JAX profiler capture: trace steps [profile_start, profile_stop)
+    # into {artifacts}/profile (viewable in XProf/TensorBoard). Net-new vs
+    # the reference, which has no profiling hooks (SURVEY.md §5.1).
+    profile_start: int = 0
+    profile_stop: int = 0
 
     @classmethod
     def from_params(cls, params: Dict[str, Any]) -> "TrainJobConfig":
@@ -158,13 +163,21 @@ def run_training(job: TrainJobConfig,
     t_start = time.perf_counter()
     tokens_done = 0
 
+    profiling = False
     with jax.set_mesh(mesh):
         for i in range(start_step, job.steps):
+            if job.profile_stop > job.profile_start and i == job.profile_start:
+                jax.profiler.start_trace(os.path.join(artifacts, "profile"))
+                profiling = True
             batch = {k: np.asarray(v) for k, v in next(batches).items()}
             if lora_mode:
                 state, metrics = step_fn(state, base_params, batch)
             else:
                 state, metrics = step_fn(state, batch)
+            if profiling and i + 1 == job.profile_stop:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profiling = False
             tokens_done += tokens_per_step
             if (i + 1) % job.log_every == 0 or i + 1 == job.steps:
                 loss = float(metrics["loss"])
@@ -179,6 +192,8 @@ def run_training(job: TrainJobConfig,
             if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:
                 ckpt.save(i + 1, state)
 
+    if profiling:  # profile window ran past the last step
+        jax.profiler.stop_trace()
     ckpt.wait()
     summary = {
         "final_loss": history[-1]["loss"] if history else None,
