@@ -13,6 +13,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -54,7 +55,18 @@ def main() -> None:
         model, batch_size, seq = "debug", 4, 128
         steps, warmup = 3, 1
 
-    cfg = get_config(model)
+    # Tuning knobs without code edits (e.g. RBT_BENCH_MODEL=bench-1b
+    # RBT_BENCH_BS=4 RBT_BENCH_IMPL=flash).
+    model = os.environ.get("RBT_BENCH_MODEL", model)
+    batch_size = int(os.environ.get("RBT_BENCH_BS", batch_size))
+    seq = int(os.environ.get("RBT_BENCH_SEQ", seq))
+    overrides = {}
+    if os.environ.get("RBT_BENCH_IMPL"):
+        overrides["attention_impl"] = os.environ["RBT_BENCH_IMPL"]
+    if os.environ.get("RBT_BENCH_REMAT"):
+        overrides["remat_policy"] = os.environ["RBT_BENCH_REMAT"]
+
+    cfg = get_config(model, **overrides)
     mesh = single_device_mesh()
     opt = make_optimizer(OptimizerConfig(total_steps=10_000, warmup_steps=10))
     state, shardings = create_train_state(cfg, opt, mesh, jax.random.key(0))

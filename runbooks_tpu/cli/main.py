@@ -350,6 +350,30 @@ def cmd_notebook(args) -> int:
     return _kubectl_port_forward(f"pod/{pod}", 8888, 8888, args.namespace)
 
 
+def cmd_logs(args) -> int:
+    """Stream logs of an object's workload pods (the reference TUI streams
+    these inline — internal/tui/pods.go; here it shells to kubectl with the
+    same role/kind labels the reconcilers stamp on pods)."""
+    kind, name = parse_scope(args.scope)
+    if not kind or not name:
+        raise SystemExit("usage: rbt logs <kind>/<name> [--role build|run]")
+    selector = f"{kind.lower()}={name},role={args.role}"
+    # kubectl defaults: --tail=10 with selectors (silent truncation) and a
+    # 5-stream cap on -f (breaks multi-host slices); lift both.
+    cmd = ["kubectl", "logs", "-n", args.namespace, "-l", selector,
+           "--all-containers", "--prefix", f"--tail={args.tail}",
+           "--max-log-requests", "64"]
+    if args.follow:
+        cmd.append("-f")
+    try:
+        return subprocess.call(cmd)
+    except FileNotFoundError:
+        print("kubectl not found on PATH", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_suspend(args) -> int:
     client = make_client(args)
     kind, name = parse_scope(args.scope)
@@ -437,6 +461,14 @@ def build_parser() -> argparse.ArgumentParser:
     common(sp)
     sp.add_argument("--no-sync", dest="sync", action="store_false")
     sp.set_defaults(func=cmd_notebook)
+
+    sp = sub.add_parser("logs", help="stream workload pod logs")
+    sp.add_argument("scope")
+    sp.add_argument("--role", default="run", choices=["run", "build"])
+    sp.add_argument("-f", "--follow", action="store_true")
+    sp.add_argument("--tail", type=int, default=-1,
+                    help="lines per container (-1 = all)")
+    sp.set_defaults(func=cmd_logs)
 
     sp = sub.add_parser("suspend", help="suspend a notebook")
     sp.add_argument("scope")

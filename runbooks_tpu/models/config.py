@@ -65,6 +65,12 @@ class ModelConfig:
     # over the "sequence" mesh axis; shard_map + ppermute).
     attention_impl: str = "xla"
 
+    # Embedding lookup as one-hot matmul instead of gather. Under a
+    # tensor-sharded vocab, GSPMD partitions the matmul cleanly where the
+    # gather forces a full rematerialization reshard; costs extra FLOPs, so
+    # it's a measured choice, not the default.
+    embed_one_hot: bool = False
+
     # Dtypes
     dtype: str = "bfloat16"           # activation dtype
     param_dtype: str = "float32"      # master param dtype

@@ -424,7 +424,12 @@ def forward(
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
                                          (b, s))
 
-    x = params["embed"].astype(ad)[tokens]
+    if cfg.embed_one_hot:
+        one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=ad)
+        x = jnp.einsum("bsv,vh->bsh", one_hot, params["embed"].astype(ad),
+                       preferred_element_type=jnp.float32).astype(ad)
+    else:
+        x = params["embed"].astype(ad)[tokens]
     if cfg.embed_scale:
         x = x * (cfg.hidden_size ** 0.5)
     if cfg.position_type == "learned":

@@ -1,0 +1,139 @@
+"""Cloud + TPU resources unit tests (reference analogs:
+internal/cloud/common_test.go, gcp_test.go, internal/resources/
+resources_test.go)."""
+
+import pytest
+
+from runbooks_tpu.api.types import Model
+from runbooks_tpu.cloud.base import (
+    BucketMount,
+    CommonConfig,
+    image_name,
+    image_tag_for,
+    object_bucket_path,
+    parse_bucket_url,
+)
+from runbooks_tpu.cloud.gcp import GCPCloud, GCPConfig
+from runbooks_tpu.cloud.local import LocalCloud
+from runbooks_tpu.cloud.resources import (
+    TPU_TYPES,
+    apply_tpu_resources,
+    distributed_env,
+    fan_out_job,
+    parse_tpu,
+)
+
+
+def test_bucket_path_is_deterministic_md5():
+    m = Model.new("m1", namespace="ns1")
+    p1 = object_bucket_path("c1", m)
+    p2 = object_bucket_path("c1", Model.new("m1", namespace="ns1"))
+    assert p1 == p2 and len(p1) == 32
+    assert p1 != object_bucket_path("c2", m)          # cluster-scoped
+    assert p1 != object_bucket_path("c1", Model.new("m2", namespace="ns1"))
+
+
+def test_image_naming_and_tags():
+    cfg = CommonConfig(cluster_name="clu", registry_url="reg.io/p/r")
+    m = Model.new("my-model", namespace="team")
+    assert image_name(cfg, m, "abc") == "reg.io/p/r/clu-model-team-my-model:abc"
+    assert image_tag_for(m) == "latest"
+    m.spec["build"] = {"git": {"url": "u", "branch": "dev"}}
+    assert image_tag_for(m) == "dev"
+    m.spec["build"] = {"git": {"url": "u", "tag": "v1", "branch": "dev"}}
+    assert image_tag_for(m) == "v1"
+    m.spec["build"] = {"upload": {"md5checksum": "f" * 32}}
+    assert image_tag_for(m) == "f" * 32
+
+
+def test_parse_bucket_url():
+    assert parse_bucket_url("gs://b/p/x") == ("gs", "b/p/x")
+    with pytest.raises(ValueError):
+        parse_bucket_url("no-scheme")
+
+
+@pytest.mark.parametrize("tpu,chips,hosts", [
+    ({"type": "v5e", "topology": "1x1"}, 1, 1),
+    ({"type": "v5e", "topology": "2x2"}, 4, 1),
+    ({"type": "v5e", "topology": "2x4"}, 8, 2),
+    ({"type": "v5e", "topology": "4x4"}, 16, 4),
+    ({"type": "v5p", "topology": "2x2x1"}, 4, 1),
+    ({"type": "v5p", "topology": "2x2x2"}, 8, 2),
+    ({"type": "v5p", "topology": "4x4x4"}, 64, 16),
+    ({"type": "v6e", "topology": "2x4"}, 8, 2),
+])
+def test_tpu_topology_math(tpu, chips, hosts):
+    s = parse_tpu(tpu)
+    assert s.chips == chips and s.hosts == hosts
+    assert s.accelerator == TPU_TYPES[tpu["type"]]["accelerator"]
+
+
+def test_tpu_validation_errors():
+    with pytest.raises(ValueError, match="unknown tpu type"):
+        parse_tpu({"type": "v99", "topology": "2x2"})
+    with pytest.raises(ValueError, match="3-dimensional"):
+        parse_tpu({"type": "v5p", "topology": "2x2"})
+    with pytest.raises(ValueError, match="2-dimensional"):
+        parse_tpu({"type": "v5e", "topology": "2x2x2"})
+    with pytest.raises(ValueError, match="invalid tpu topology"):
+        parse_tpu({"type": "v5e", "topology": "axb"})
+
+
+def test_fan_out_env_and_spot():
+    slice_ = parse_tpu({"type": "v5e", "topology": "4x4"})
+    pod_spec = {"containers": [{"name": "model"}]}
+    apply_tpu_resources(pod_spec, "model", slice_, spot=True)
+    assert pod_spec["nodeSelector"]["cloud.google.com/gke-spot"] == "true"
+    assert pod_spec["tolerations"][0]["key"] == "cloud.google.com/gke-spot"
+
+    env = distributed_env("job", "svc", "ns", slice_)
+    env_map = {e["name"]: e for e in env}
+    assert env_map["JAX_COORDINATOR_ADDRESS"]["value"] == \
+        "job-0.svc.ns.svc.cluster.local:8476"
+    assert env_map["JAX_NUM_PROCESSES"]["value"] == "4"
+    hostnames = env_map["TPU_WORKER_HOSTNAMES"]["value"].split(",")
+    assert len(hostnames) == 4
+
+    job = {"metadata": {"name": "job", "namespace": "ns"},
+           "spec": {"template": {"spec": pod_spec}}}
+    svc = fan_out_job(job, slice_)
+    assert svc["spec"]["clusterIP"] == "None"
+    assert job["spec"]["completionMode"] == "Indexed"
+    assert job["spec"]["completions"] == 4
+
+
+def test_gcp_mounts_gcsfuse_csi():
+    cloud = GCPCloud(GCPConfig(common=CommonConfig(
+        cluster_name="c", artifact_bucket_url="gs://my-bucket",
+        registry_url="reg", principal="gsa@p.iam.gserviceaccount.com")))
+    m = Model.new("m")
+    assert cloud.object_artifact_url(m).startswith("gs://my-bucket/")
+
+    pod_meta, pod_spec = {}, {"containers": [{"name": "model"}]}
+    cloud.mount_bucket(pod_meta, pod_spec, m,
+                       BucketMount("artifacts", "artifacts", read_only=False))
+    assert pod_meta["annotations"]["gke-gcsfuse/volumes"] == "true"
+    vol = pod_spec["volumes"][0]
+    assert vol["csi"]["driver"] == "gcsfuse.csi.storage.gke.io"
+    assert vol["csi"]["volumeAttributes"]["bucketName"] == "my-bucket"
+    vm = pod_spec["containers"][0]["volumeMounts"][0]
+    assert vm["mountPath"] == "/content/artifacts"
+    assert vm["subPath"].endswith("/artifacts")
+    assert pod_spec["securityContext"]["fsGroup"] == 3003
+
+    sa = {"metadata": {"name": "modeller"}}
+    principal, bound = cloud.get_principal(sa)
+    assert not bound
+    cloud.associate_principal(sa)
+    _, bound = cloud.get_principal(sa)
+    assert bound
+
+
+def test_local_cloud_hostpath_mounts():
+    cloud = LocalCloud(CommonConfig(cluster_name="c"))
+    m = Model.new("m")
+    pod_meta, pod_spec = {}, {"containers": [{"name": "model"}]}
+    cloud.mount_bucket(pod_meta, pod_spec, m, BucketMount("artifacts", "data"))
+    vol = pod_spec["volumes"][0]
+    assert "hostPath" in vol
+    assert pod_spec["containers"][0]["volumeMounts"][0]["readOnly"]
