@@ -157,6 +157,48 @@ def headless_service(job_name: str, namespace: str) -> dict:
     }
 
 
+def multislice_env(num_slices: int, slice_id: int, coordinator: str
+                   ) -> List[dict]:
+    """MEGASCALE env for multi-slice training over DCN: each slice is its own
+    ICI domain; XLA's DCN collectives stitch slices together. Coordinator is
+    slice 0's host 0."""
+    return [
+        {"name": "MEGASCALE_COORDINATOR_ADDRESS", "value": coordinator},
+        {"name": "MEGASCALE_NUM_SLICES", "value": str(num_slices)},
+        {"name": "MEGASCALE_SLICE_ID", "value": str(slice_id)},
+    ]
+
+
+def multislice_jobs(job: dict, slice_: TPUSlice,
+                    num_slices: int) -> List[dict]:
+    """Expand one workload Job into num_slices jobs ({name}-slice-{i}), each
+    fanned out across its hosts, all joined over DCN via MEGASCALE env.
+    Returns the flat list of objects to create (jobs + headless services).
+    The reference has no multi-node story at all (SURVEY.md §2a); this is
+    the v4/v5 multislice topology first-class."""
+    import copy
+
+    base_name = job["metadata"]["name"]
+    namespace = job["metadata"].get("namespace", "default")
+    coordinator = (f"{base_name}-slice-0-0.{base_name}-slice-0."
+                   f"{namespace}.svc.cluster.local:{JAX_COORDINATOR_PORT}")
+    out: List[dict] = []
+    for i in range(num_slices):
+        j = copy.deepcopy(job)
+        j["metadata"]["name"] = f"{base_name}-slice-{i}"
+        j["metadata"].setdefault("labels", {})["slice"] = str(i)
+        svc = fan_out_job(j, slice_)
+        env = multislice_env(num_slices, i, coordinator)
+        for container in j["spec"]["template"]["spec"].get("containers", []):
+            existing = {e["name"] for e in container.setdefault("env", [])}
+            container["env"].extend(e for e in env
+                                    if e["name"] not in existing)
+        out.append(j)
+        if svc is not None:
+            out.append(svc)
+    return out
+
+
 def fan_out_job(job: dict, slice_: TPUSlice) -> Optional[dict]:
     """Turn a single-pod Job into a multi-host indexed Job; returns the
     headless Service to create alongside (None when single-host).

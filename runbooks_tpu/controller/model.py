@@ -72,21 +72,27 @@ class ModelReconciler:
                 return Result(requeue_after=2.0)
 
         job_name = f"{model.name}-modeller"
-        existing = ctx.client.get("batch/v1", "Job", model.namespace,
-                                  job_name)
-        if existing is None:
-            job, svc = self._modeller_job(ctx, model, base, dataset, job_name)
-            if svc is not None:
-                if ctx.client.get("v1", "Service", model.namespace,
-                                  ko.name(svc)) is None:
-                    ko.set_owner(svc, model.obj)
-                    ctx.client.create(svc)
-            ctx.client.create(job)
+        num_slices = int((model.tpu or {}).get("slices", 1))
+        job_names = ([f"{job_name}-slice-{i}" for i in range(num_slices)]
+                     if num_slices > 1 else [job_name])
+        existing_jobs = [ctx.client.get("batch/v1", "Job", model.namespace, n)
+                         for n in job_names]
+        if any(j is None for j in existing_jobs):
+            for obj in self._modeller_objects(ctx, model, base, dataset,
+                                              job_name, num_slices):
+                kind = obj["kind"]
+                av = obj["apiVersion"]
+                if ctx.client.get(av, kind, model.namespace,
+                                  ko.name(obj)) is None:
+                    ko.set_owner(obj, model.obj)
+                    ctx.client.create(obj)
             model.set_condition(cond.COMPLETE, False, cond.REASON_JOB_RUNNING)
             ctx.client.update_status(model.obj)
             return Result(requeue_after=2.0)
 
-        complete, failed = job_status(existing)
+        statuses = [job_status(j) for j in existing_jobs]
+        complete = all(c for c, _ in statuses)
+        failed = any(f for _, f in statuses)
         if failed:
             model.set_condition(cond.COMPLETE, False, cond.REASON_JOB_FAILED,
                                 f"job {job_name} failed")
@@ -106,6 +112,24 @@ class ModelReconciler:
         return Result()
 
     # ------------------------------------------------------------------
+
+    def _modeller_objects(self, ctx: Ctx, model: Model, base, dataset,
+                          job_name: str, num_slices: int = 1):
+        """All objects to create for the workload: one Job (plus headless
+        Service when multi-host), times num_slices for DCN multislice."""
+        job = self._modeller_job(ctx, model, base, dataset, job_name)
+        tpu = parse_tpu(model.tpu) if model.tpu else None
+        if num_slices > 1:
+            if tpu is None:
+                raise ValueError("tpu.slices requires a tpu block")
+            from runbooks_tpu.cloud.resources import multislice_jobs
+
+            return multislice_jobs(job, tpu, num_slices)
+        if tpu is not None:
+            svc = fan_out_job(job, tpu)
+            if svc is not None:
+                return [job, svc]
+        return [job]
 
     def _modeller_job(self, ctx: Ctx, model: Model, base, dataset,
                       job_name: str):
@@ -154,5 +178,4 @@ class ModelReconciler:
             },
         }
         ko.set_owner(job, model.obj)
-        svc = fan_out_job(job, tpu) if tpu is not None else None
-        return job, svc
+        return job

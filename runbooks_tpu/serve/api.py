@@ -165,6 +165,9 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         except json.JSONDecodeError:
             return web.json_response(
                 {"error": {"message": "invalid JSON body"}}, status=400)
+        return await _complete(request.app, body)
+
+    async def _complete(app_, body) -> web.Response:
         prompt = body.get("prompt")
         if prompt is None:
             return web.json_response(
@@ -189,7 +192,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             return web.json_response(
                 {"error": {"message": "max_tokens must be >= 1"}}, status=400)
 
-        tok = request.app["tokenizer"]
+        tok = app_["tokenizer"]
         eos = getattr(tok, "eos_id", None) or getattr(tok, "eos_token_id",
                                                       None)
         reqs = []
@@ -200,7 +203,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 prompt_tokens=list(ids), max_tokens=max_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos))
-        worker = request.app["worker"]
+        worker = app_["worker"]
         try:
             futs = [asyncio.wrap_future(worker.submit(r)) for r in reqs]
         except ValueError as exc:  # e.g. prompt exceeds the context window
@@ -237,7 +240,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "created": int(time.time()),
-            "model": request.app["model_name"],
+            "model": app_["model_name"],
             "choices": choices,
             "usage": {
                 "prompt_tokens": prompt_tokens,
@@ -246,9 +249,50 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             },
         })
 
+    async def chat_completions(request: web.Request) -> web.Response:
+        """Minimal OpenAI-compatible chat endpoint: messages are rendered
+        with a plain role-prefix template (model-specific templates come from
+        the tokenizer when it has one)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400)
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return web.json_response(
+                {"error": {"message": "missing required field: messages"}},
+                status=400)
+        tok = request.app["tokenizer"]
+        if hasattr(tok, "apply_chat_template"):
+            try:
+                prompt = tok.apply_chat_template(
+                    messages, tokenize=False, add_generation_prompt=True)
+            except Exception:
+                prompt = None
+        else:
+            prompt = None
+        if prompt is None:
+            parts = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+                     for m in messages]
+            prompt = "\n".join(parts) + "\nassistant:"
+        body["prompt"] = prompt
+        resp = await _complete(request.app, body)
+        if resp.status != 200:
+            return resp
+        payload = json.loads(resp.body)
+        payload["object"] = "chat.completion"
+        payload["choices"] = [{
+            "index": c["index"],
+            "message": {"role": "assistant", "content": c["text"]},
+            "finish_reason": c["finish_reason"],
+        } for c in payload["choices"]]
+        return web.json_response(payload)
+
     app.router.add_get("/", root)
     app.router.add_get("/healthz", healthz)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/chat/completions", chat_completions)
 
     async def on_cleanup(app):
         worker.stop()

@@ -211,6 +211,34 @@ def test_model_tpu_multihost_fanout(harness):
     assert svc is not None and svc["spec"]["clusterIP"] == "None"
 
 
+def test_model_tpu_multislice(harness):
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("ms", spec={
+        "image": "trainer",
+        "resources": {"tpu": {"type": "v5e", "topology": "2x4",
+                              "slices": 2}}}).obj)
+    mgr.reconcile_until_stable()
+    jobs = [client.get("batch/v1", "Job", "default", f"ms-modeller-slice-{i}")
+            for i in range(2)]
+    assert all(jobs)
+    for i, job in enumerate(jobs):
+        env = {e["name"]: e.get("value") for e in
+               job["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == str(i)
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith(
+            "ms-modeller-slice-0-0.")
+        assert job["spec"]["completions"] == 2  # 2 hosts per slice
+
+    # completes only when ALL slices complete
+    client.mark_job_complete("default", "ms-modeller-slice-0")
+    mgr.reconcile_until_stable()
+    assert not Model(get(client, "Model", "ms")).ready
+    client.mark_job_complete("default", "ms-modeller-slice-1")
+    mgr.reconcile_until_stable()
+    assert Model(get(client, "Model", "ms")).ready
+
+
 # ---------------------------------------------------------------------------
 # Server reconciler
 # ---------------------------------------------------------------------------

@@ -165,6 +165,17 @@ def test_http_api_end_to_end():
             body = await r.json()
             assert [c["index"] for c in body["choices"]] == [0, 1]
 
+            # chat endpoint
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 3, "temperature": 0.0})
+            assert r.status == 200
+            body = await r.json()
+            assert body["object"] == "chat.completion"
+            assert body["choices"][0]["message"]["role"] == "assistant"
+            r = await client.post("/v1/chat/completions", json={})
+            assert r.status == 400
+
             # malformed requests
             r = await client.post("/v1/completions", json={"max_tokens": 4})
             assert r.status == 400
