@@ -17,3 +17,22 @@ test-fast:
 .PHONY: bench
 bench:
 	python bench.py
+
+# Regenerate CRD manifests (reference analog: `make manifests`).
+.PHONY: manifests
+manifests:
+	python -m runbooks_tpu.api.crds config/crd
+
+# Regenerate protobuf message classes (reference analog: `make protogen`).
+.PHONY: protogen
+protogen:
+	cd runbooks_tpu/sci && protoc --python_out=. sci.proto
+
+.PHONY: nbwatch
+nbwatch:
+	$(MAKE) -C native/nbwatch
+
+# In-process system test (reference analog: `make test-system-kind`).
+.PHONY: test-system
+test-system:
+	$(TEST_ENV) python test/system.py

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Install `kubectl rbt` as a kubectl plugin (reference analog:
+# install/kubectl-plugins.sh, which shims `kubectl sub`).
+set -euo pipefail
+
+BIN_DIR="${BIN_DIR:-/usr/local/bin}"
+cat > "${BIN_DIR}/kubectl-rbt" <<'EOF'
+#!/usr/bin/env bash
+exec python -m runbooks_tpu.cli.main "$@"
+EOF
+chmod +x "${BIN_DIR}/kubectl-rbt"
+echo "installed ${BIN_DIR}/kubectl-rbt — try: kubectl rbt get"

@@ -1,0 +1,10 @@
+from runbooks_tpu.models.config import CONFIGS, ModelConfig, get_config
+from runbooks_tpu.models.transformer import (
+    KVCache,
+    forward,
+    init_params,
+    param_logical_axes,
+)
+
+__all__ = ["CONFIGS", "ModelConfig", "get_config", "KVCache", "forward",
+           "init_params", "param_logical_axes"]

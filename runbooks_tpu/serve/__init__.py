@@ -1,0 +1,3 @@
+from runbooks_tpu.serve.engine import InferenceEngine, Request
+
+__all__ = ["InferenceEngine", "Request"]

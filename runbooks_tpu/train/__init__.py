@@ -1,0 +1,15 @@
+from runbooks_tpu.train.checkpoint import CheckpointManager
+from runbooks_tpu.train.lora import LoraConfig, apply_lora, init_lora
+from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
+from runbooks_tpu.train.step import (
+    TrainState,
+    create_train_state,
+    cross_entropy_loss,
+    make_train_step,
+)
+from runbooks_tpu.train.trainer import TrainJobConfig, run_training
+
+__all__ = ["CheckpointManager", "LoraConfig", "apply_lora", "init_lora",
+           "OptimizerConfig", "make_optimizer", "TrainState",
+           "create_train_state", "cross_entropy_loss", "make_train_step",
+           "TrainJobConfig", "run_training"]
