@@ -19,24 +19,12 @@ import time
 import jax
 import jax.numpy as jnp
 
-# bf16 peak FLOP/s per chip by TPU generation (dense).
-PEAK_BF16 = {
-    "v5 lite": 197e12,  # v5e
-    "v5litepod": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v4": 275e12,
-    "v6e": 918e12,
-    "cpu": 1e12,  # nominal, so the bench still runs off-TPU
-}
+from runbooks_tpu.utils.hw import chip_peak_flops as _chip_peak
 
 
 def chip_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, val in PEAK_BF16.items():
-        if key in kind:
-            return val
-    return PEAK_BF16["cpu"]
+    # Nominal 1 TFLOP/s off-TPU so the bench still emits numbers anywhere.
+    return _chip_peak(device) or 1e12
 
 
 def main() -> None:

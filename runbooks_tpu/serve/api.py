@@ -140,15 +140,20 @@ class EngineWorker:
 def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   max_slots: int = 8,
                   max_seq_len: Optional[int] = None,
-                  mesh=None) -> web.Application:
+                  mesh=None, warmup: bool = False) -> web.Application:
     tokenizer = tokenizer or load_tokenizer(None)
     engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
                              max_seq_len=max_seq_len, mesh=mesh)
+    if warmup:
+        engine.warmup()  # pre-compile all buckets before readiness flips
     worker = EngineWorker(engine)
     app = web.Application()
     app["worker"] = worker
     app["tokenizer"] = tokenizer
     app["model_name"] = cfg.name
+    app["requests_total"] = 0
+    app["requests_failed_total"] = 0
+    app["tokens_total"] = 0
     started = time.time()
 
     async def root(request: web.Request) -> web.Response:
@@ -158,6 +163,19 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
 
     async def healthz(request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
+
+    async def metrics(request: web.Request) -> web.Response:
+        eng = worker.engine
+        lines = [
+            f"serve_requests_total {app['requests_total']}",
+            f"serve_requests_failed_total {app['requests_failed_total']}",
+            f"serve_tokens_generated_total {app['tokens_total']}",
+            f"serve_decode_steps_total {eng.steps}",
+            f"serve_active_slots {int(eng.active.sum())}",
+            f"serve_queue_depth {len(eng.queue)}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
 
     async def completions(request: web.Request) -> web.Response:
         try:
@@ -204,21 +222,26 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos))
         worker = app_["worker"]
+        app_["requests_total"] += len(reqs)
         try:
             futs = [asyncio.wrap_future(worker.submit(r)) for r in reqs]
         except ValueError as exc:  # e.g. prompt exceeds the context window
+            app_["requests_failed_total"] += len(reqs)
             return web.json_response(
                 {"error": {"message": str(exc)}}, status=400)
         try:
             done_reqs = await asyncio.wait_for(
                 asyncio.gather(*futs), timeout=600)
         except asyncio.TimeoutError:
+            app_["requests_failed_total"] += len(reqs)
             return web.json_response(
                 {"error": {"message": "generation timed out"}}, status=504)
         except ValueError as exc:
+            app_["requests_failed_total"] += len(reqs)
             return web.json_response(
                 {"error": {"message": str(exc)}}, status=400)
         except Exception as exc:  # noqa: BLE001 — engine failure surfaced
+            app_["requests_failed_total"] += len(reqs)
             return web.json_response(
                 {"error": {"message": f"engine failure: {exc}"}}, status=500)
 
@@ -236,6 +259,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             })
             prompt_tokens += len(reqs[i].prompt_tokens)
             completion_tokens += len(done.output_tokens)
+        app_["tokens_total"] += completion_tokens
         return web.json_response({
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
@@ -291,6 +315,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
 
     app.router.add_get("/", root)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
 
@@ -326,7 +351,8 @@ def main() -> int:
         cfg, model_params, tokenizer,
         max_slots=int(params.get("max_slots", 8)),
         max_seq_len=params.get("max_seq_len"),
-        mesh=mesh)
+        mesh=mesh,
+        warmup=bool(params.get("warmup", True)))
     port = int(params.get("port", contract.SERVE_PORT))
     web.run_app(app, port=port, print=lambda *a: None)
     return 0

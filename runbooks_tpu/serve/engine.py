@@ -155,6 +155,33 @@ class InferenceEngine:
 
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
 
+    def warmup(self) -> None:
+        """Compile every prefill bucket + the decode step ahead of traffic
+        (first-request latency otherwise pays 1-2 compiles). Slot state is
+        reset afterwards."""
+        for bucket in self.prefill_buckets:
+            padded = np.zeros((1, bucket), np.int32)
+            positions = np.full((1, bucket), self._pad_slot, np.int32)
+            positions[0, :2] = [0, 1]
+            with self._mesh_ctx():
+                _, new_k, new_v = self._prefill(
+                    self.params, self.cache.k, self.cache.v,
+                    jnp.asarray(padded), jnp.asarray(positions),
+                    jnp.asarray(0, jnp.int32))
+            self.cache = KVCache(k=new_k, v=new_v, index=self.cache.index)
+        zeros = np.zeros(self.max_slots, np.int32)
+        with self._mesh_ctx():
+            _, self.cache = self._decode(
+                self.params, self.cache,
+                jnp.asarray(zeros[:, None]),
+                jnp.asarray(np.full((self.max_slots, 1), self._pad_slot,
+                                    np.int32)),
+                jax.random.key(0),
+                jnp.zeros(self.max_slots, jnp.float32),
+                jnp.zeros(self.max_slots, jnp.int32),
+                jnp.ones(self.max_slots, jnp.float32))
+        self.reset()
+
     # ------------------------------------------------------------------
     # Request lifecycle
     # ------------------------------------------------------------------

@@ -160,6 +160,9 @@ def run_training(job: TrainJobConfig,
     history = []
     tokens_per_step = job.batch_size * job.seq_len
     flops_per_token = 3.0 * model_cfg.flops_per_token(job.seq_len)
+    from runbooks_tpu.utils.hw import chip_peak_flops
+
+    peak_flops = chip_peak_flops(jax.devices()[0]) * len(jax.devices())
     t_start = time.perf_counter()
     tokens_done = 0
 
@@ -183,10 +186,12 @@ def run_training(job: TrainJobConfig,
                 loss = float(metrics["loss"])
                 dt = time.perf_counter() - t_start
                 tps = tokens_done / dt
+                achieved = tps * flops_per_token
                 entry = {"step": i + 1, "loss": round(loss, 4),
                          "tokens_per_sec": round(tps, 1),
-                         "tflops_per_sec": round(tps * flops_per_token / 1e12,
-                                                 2)}
+                         "tflops_per_sec": round(achieved / 1e12, 2)}
+                if peak_flops:
+                    entry["mfu"] = round(achieved / peak_flops, 4)
                 history.append(entry)
                 print(json.dumps(entry), flush=True)
             if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:

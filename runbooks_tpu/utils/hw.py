@@ -1,0 +1,23 @@
+"""Hardware peak numbers for MFU accounting (shared by bench + trainer)."""
+
+from __future__ import annotations
+
+# Dense bf16 peak FLOP/s per chip by TPU generation.
+PEAK_BF16 = {
+    "v5 lite": 197e12,   # v5e
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+
+
+def chip_peak_flops(device) -> float:
+    """Peak bf16 FLOP/s for a jax.Device; 0.0 when unknown (e.g. CPU), so
+    callers can skip MFU reporting rather than report nonsense."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_BF16.items():
+        if key in kind:
+            return val
+    return 0.0

@@ -134,6 +134,53 @@ def test_engine_sharded_matches_unsharded():
     assert len({s.device for s in wq.addressable_shards}) == 8
 
 
+def test_engine_warmup_precompiles_and_resets():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = InferenceEngine(cfg, params, max_slots=2)
+    engine.warmup()
+    assert not engine.active.any() and not engine.queue
+    # Generation after warmup still correct.
+    r = Request(prompt_tokens=[5, 9, 17], max_tokens=4, temperature=0.0)
+    engine.generate([r])
+    assert r.output_tokens == greedy_rollout(cfg, params, [5, 9, 17], 4)
+
+
+def test_worker_crash_containment():
+    """An engine failure mid-flight must fail waiting requests with the
+    error and leave the worker serving subsequent requests."""
+    from runbooks_tpu.serve.api import EngineWorker
+
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = InferenceEngine(cfg, params, max_slots=2)
+    worker = EngineWorker(engine)
+
+    boom = {"armed": True}
+    orig_step = engine.step
+
+    def exploding_step():
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("synthetic device failure")
+        return orig_step()
+
+    engine.step = exploding_step
+    fut = worker.submit(Request(prompt_tokens=[1, 2], max_tokens=3,
+                                temperature=0.0))
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="synthetic device failure"):
+        fut.result(timeout=30)
+
+    # Worker thread survived; next request succeeds on the reset engine.
+    fut2 = worker.submit(Request(prompt_tokens=[1, 2], max_tokens=3,
+                                 temperature=0.0))
+    done = fut2.result(timeout=60)
+    assert len(done.output_tokens) == 3
+    worker.stop()
+
+
 def test_http_api_end_to_end():
     from aiohttp.test_utils import TestClient, TestServer
 
