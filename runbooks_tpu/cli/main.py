@@ -22,11 +22,11 @@ import subprocess
 import sys
 import time
 import urllib.request
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import yaml
 
-from runbooks_tpu.api.types import API_VERSION, KINDS, wrap
+from runbooks_tpu.api.types import API_VERSION, KINDS
 from runbooks_tpu.k8s import objects as ko
 
 KIND_ORDER = {"Dataset": 0, "Model": 1, "Server": 2, "Notebook": 3}

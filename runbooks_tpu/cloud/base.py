@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import os
-from typing import Dict, Optional, Protocol
+from typing import Protocol
 
 from runbooks_tpu.api.types import Resource
 

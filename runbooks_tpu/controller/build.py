@@ -12,7 +12,6 @@ prefix: uploads/latest.tar.gz (:29).
 from __future__ import annotations
 
 import time
-from typing import Optional
 
 from runbooks_tpu.api import conditions as cond
 from runbooks_tpu.api.types import API_VERSION, KIND_TO_CLASS, Resource

@@ -11,9 +11,8 @@ from __future__ import annotations
 import threading
 import time
 import uuid
-from typing import Optional
 
-from runbooks_tpu.k8s import objects as ko
+
 
 LEASE_API = "coordination.k8s.io/v1"
 

@@ -16,9 +16,9 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Protocol
+from typing import Dict, List, Optional, Protocol
 
-from runbooks_tpu.api.types import API_VERSION, KINDS, wrap
+from runbooks_tpu.api.types import API_VERSION
 from runbooks_tpu.k8s import objects as ko
 
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Optional, Tuple
+from typing import Optional
 
 from runbooks_tpu.sci.base import DEFAULT_EXPIRY_SECONDS
 

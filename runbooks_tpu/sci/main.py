@@ -15,7 +15,6 @@ signed URLs).
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 

@@ -29,7 +29,6 @@ from runbooks_tpu.train.lora import (
     LoraConfig,
     create_lora_train_state,
     make_lora_train_step,
-    merge as lora_merge,
 )
 from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
 from runbooks_tpu.train.step import create_train_state, make_train_step
