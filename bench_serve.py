@@ -1,0 +1,93 @@
+"""Serving benchmark: TTFT percentiles + decode throughput.
+
+BASELINE.json tracks "Server p50 TTFT" as a headline serving metric; this
+bench measures it against the in-process engine (no HTTP overhead): N
+concurrent requests through the continuous-batching worker, reporting TTFT
+p50/p90 (time to first generated token) and aggregate decode tokens/sec.
+
+Prints ONE JSON line. Knobs: RBT_BENCH_MODEL / RBT_BENCH_SLOTS /
+RBT_BENCH_REQUESTS / RBT_BENCH_PROMPT / RBT_BENCH_MAXTOK.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.serve.api import EngineWorker
+    from runbooks_tpu.serve.engine import InferenceEngine, Request
+
+    device = jax.devices()[0]
+    on_tpu = jax.default_backend() == "tpu"
+    model = os.environ.get("RBT_BENCH_MODEL",
+                           "bench-410m" if on_tpu else "debug")
+    slots = int(os.environ.get("RBT_BENCH_SLOTS", 8))
+    n_requests = int(os.environ.get("RBT_BENCH_REQUESTS", 16))
+    prompt_len = int(os.environ.get("RBT_BENCH_PROMPT",
+                                    128 if on_tpu else 16))
+    max_tokens = int(os.environ.get("RBT_BENCH_MAXTOK",
+                                    64 if on_tpu else 8))
+
+    cfg = get_config(model, param_dtype="bfloat16" if on_tpu else "float32")
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+    engine = InferenceEngine(cfg, params, max_slots=slots)
+    engine.warmup()
+    worker = EngineWorker(engine)
+
+    class TimedList(list):
+        """List that records the time of its first append (= first token)."""
+
+        def __init__(self, start, sink):
+            super().__init__()
+            self._start, self._sink = start, sink
+
+        def append(self, tok):
+            if not self:
+                self._sink(time.perf_counter() - self._start)
+            super().append(tok)
+
+    rng = np.random.default_rng(0)
+    ttfts = []
+    lock = threading.Lock()
+
+    def sink(dt):
+        with lock:
+            ttfts.append(dt)
+
+    t_all = time.perf_counter()
+    futs = []
+    for _ in range(n_requests):
+        toks = rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+        req = Request(prompt_tokens=toks, max_tokens=max_tokens,
+                      temperature=0.0)
+        req.output_tokens = TimedList(time.perf_counter(), sink)
+        futs.append(worker.submit(req))
+    done = [f.result(timeout=600) for f in futs]
+    wall = time.perf_counter() - t_all
+    worker.stop()
+
+    total_tokens = sum(len(r.output_tokens) for r in done)
+    print(json.dumps({
+        "metric": f"{model} serve TTFT p50 ({n_requests} reqs, "
+                  f"{slots} slots, prompt {prompt_len})",
+        "value": round(statistics.median(ttfts) * 1000, 1),
+        "unit": "ms",
+        "ttft_p90_ms": round(sorted(ttfts)[int(0.9 * len(ttfts)) - 1] * 1000,
+                             1),
+        "decode_tokens_per_sec": round(total_tokens / wall, 1),
+        "device": str(device),
+    }))
+
+
+if __name__ == "__main__":
+    main()
