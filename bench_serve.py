@@ -28,7 +28,8 @@ def main() -> None:
     from runbooks_tpu.serve.engine import InferenceEngine, Request
 
     device = jax.devices()[0]
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = ("tpu" in jax.default_backend().lower()
+              or "TPU" in str(device))
     model = os.environ.get("RBT_BENCH_MODEL",
                            "bench-410m" if on_tpu else "debug")
     slots = int(os.environ.get("RBT_BENCH_SLOTS", 8))

@@ -36,7 +36,14 @@ DEFAULT_BLOCK_K = 128
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # Compile via Mosaic only on real TPU backends (PJRT plugin backends may
+    # report a vendor name rather than "tpu" — check the device too).
+    if "tpu" in jax.default_backend().lower():
+        return False
+    try:
+        return "TPU" not in str(jax.devices()[0])
+    except RuntimeError:
+        return True
 
 
 # ---------------------------------------------------------------------------
