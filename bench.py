@@ -7,34 +7,33 @@ full training step (fwd+bwd+adamw, remat, bf16 compute) on the largest
 single-chip-friendly llama config and reports MFU vs the 0.35 target:
 vs_baseline = MFU / 0.35 (>1.0 beats the target).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Structure: invoked with no args it is a stdlib-only orchestrator (benchkit)
+that runs ``bench.py --inner`` in a subprocess — TPU first when the relay
+preflight passes, forced-CPU otherwise — and always prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", "platform", ...extras}.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
 
-from runbooks_tpu.utils.hw import chip_peak_flops as _chip_peak
+def inner() -> None:
+    import jax
+    import jax.numpy as jnp
 
-
-def chip_peak_flops(device) -> float:
-    # Nominal 1 TFLOP/s off-TPU so the bench still emits numbers anywhere.
-    return _chip_peak(device) or 1e12
-
-
-def main() -> None:
     from runbooks_tpu.models.config import get_config
     from runbooks_tpu.parallel.mesh import single_device_mesh
     from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
     from runbooks_tpu.train.step import create_train_state, make_train_step
+    from runbooks_tpu.utils.hw import chip_peak_flops
 
     device = jax.devices()[0]
-    on_tpu = "tpu" in getattr(device, "platform", "").lower() or "TPU" in str(device)
+    on_tpu = ("tpu" in getattr(device, "platform", "").lower()
+              or "TPU" in str(device))
 
     if on_tpu:
         model, batch_size, seq = "bench-410m", 8, 2048
@@ -84,7 +83,8 @@ def main() -> None:
     # Train FLOPs/token ~= 3x forward matmul FLOPs (bwd ~= 2x fwd).
     train_flops_per_token = 3.0 * cfg.flops_per_token(seq)
     achieved = tokens_per_sec * train_flops_per_token
-    peak = chip_peak_flops(device)
+    # Nominal 1 TFLOP/s off-TPU so the bench still emits numbers anywhere.
+    peak = chip_peak_flops(device) or 1e12
     mfu = achieved / peak
 
     print(json.dumps({
@@ -95,9 +95,15 @@ def main() -> None:
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "step_time_s": round(dt / steps, 4),
         "loss": round(float(metrics["loss"]), 4),
+        "platform": jax.default_backend(),
         "device": str(device),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv:
+        inner()
+    else:
+        import benchkit
+        benchkit.run_outer(os.path.abspath(__file__),
+                           "llama train MFU (1 chip)", "MFU")

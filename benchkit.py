@@ -1,0 +1,142 @@
+"""Outer bench harness: run the real benchmark in a subprocess, robust to a
+dead or wedged TPU relay.
+
+Round-1 post-mortem (VERDICT.md "What's weak" 1-2): bench.py crashed (rc=1)
+when the axon relay was down because JAX backend init raised in-process, and
+the multichip dryrun hung (rc=124) because backend init blocked on a dead
+relay socket. The durable fix is to never touch the default JAX backend in
+the orchestrating process at all:
+
+- the orchestrator is stdlib-only (no jax import);
+- it preflights the relay TCP socket before attempting TPU;
+- the actual bench runs in a subprocess (``python bench.py --inner``) with a
+  timeout, so a wedged backend init cannot take down the artifact;
+- on TPU failure it retries once (the relay is single-client, so a transient
+  collision is plausible), then falls back to forced-CPU;
+- it ALWAYS prints exactly one JSON line, with the platform and any errors
+  recorded, and exits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+RELAY_PORT = 8082
+
+
+def relay_reachable(timeout: float = 2.0) -> bool:
+    """Is it safe to touch the default JAX backend? True when no relay
+    plugin is configured (nothing to preflight — plain TPU VMs or CPU boxes
+    init fine), else a cheap TCP-connect to every pool IP. Single source of
+    truth for this check — __graft_entry__ imports it."""
+    ips = [s.strip() for s in
+           (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")
+           if s.strip()]
+    for ip in ips:
+        try:
+            socket.create_connection((ip, RELAY_PORT), timeout).close()
+        except OSError:
+            return False
+    return True
+
+
+def apply_cpu_env(env=None, n_devices: int = 1):
+    """Pin an environment mapping to CPU with n virtual devices and disable
+    the relay dial. The one place the pinning recipe lives (used by the
+    bench orchestrator, tests/conftest.py, and __graft_entry__'s dryrun);
+    mutates and returns ``env`` (default: os.environ).
+
+    An existing device-count flag is REPLACED, not kept: a second call
+    asking for more devices (e.g. entry() pinned 1, dryrun needs 8) must
+    win — though it only takes effect if the CPU backend has not been
+    initialized yet."""
+    import re
+    env = os.environ if env is None else env
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize skips the axon hook
+    flags = env.get("XLA_FLAGS", "")
+    count_flag = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       count_flag, flags)
+    else:
+        flags = (flags + " " + count_flag).strip()
+    env["XLA_FLAGS"] = flags
+    return env
+
+
+def cpu_env(n_devices: int = 1) -> dict:
+    """A copy of os.environ pinned to CPU (for subprocesses)."""
+    return apply_cpu_env(dict(os.environ), n_devices)
+
+
+def _run_inner(script: str, env: dict, timeout: float):
+    """Run ``script --inner``; return (parsed-json-or-None, error-or-None,
+    elapsed-seconds)."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--inner"], env=env, timeout=timeout,
+            capture_output=True, text=True)
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or "")
+        if isinstance(tail, bytes):
+            tail = tail.decode("utf-8", "replace")
+        return None, f"timeout after {timeout:.0f}s: {tail[-1500:]}", \
+            time.time() - t0
+    elapsed = time.time() - t0
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        return None, f"rc={proc.returncode}: {proc.stderr[-1500:]}", elapsed
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None, elapsed
+            except json.JSONDecodeError:
+                continue
+    return None, f"no JSON in stdout: {proc.stdout[-1500:]}", elapsed
+
+
+def run_outer(script: str, fallback_metric: str, unit: str) -> None:
+    """Orchestrate TPU-then-CPU attempts of ``script``; always print JSON."""
+    errors: list[str] = []
+    result = None
+    tpu_timeout = float(os.environ.get("RBT_BENCH_TPU_TIMEOUT", 1200))
+    cpu_timeout = float(os.environ.get("RBT_BENCH_CPU_TIMEOUT", 900))
+
+    if os.environ.get("RBT_BENCH_FORCE_CPU") == "1":
+        errors.append("RBT_BENCH_FORCE_CPU=1: skipping TPU attempt")
+    elif not relay_reachable():
+        errors.append("tpu relay unreachable: skipping TPU attempt")
+    else:
+        result, err, elapsed = _run_inner(script, dict(os.environ),
+                                          tpu_timeout)
+        if result is None:
+            errors.append(f"tpu attempt 1: {err}")
+            # Retry only quick failures (a slow failure was likely a hang or
+            # a compile that won't improve; a quick one may be a transient
+            # relay collision — the relay is single-client).
+            if elapsed < 180 and relay_reachable():
+                time.sleep(10)
+                result, err, _ = _run_inner(script, dict(os.environ),
+                                            tpu_timeout)
+                if result is None:
+                    errors.append(f"tpu attempt 2: {err}")
+
+    if result is None:
+        result, err, _ = _run_inner(script, cpu_env(), cpu_timeout)
+        if result is None:
+            errors.append(f"cpu attempt: {err}")
+
+    if result is None:
+        result = {"metric": fallback_metric, "value": 0.0, "unit": unit,
+                  "vs_baseline": 0.0, "platform": "none"}
+    if errors:
+        result["bench_errors"] = errors
+    print(json.dumps(result))

@@ -7,16 +7,31 @@ without cloud dependencies (SURVEY.md §4: envtest + kind cloud).
 """
 
 import os
+import sys
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Must be set before jax is imported anywhere. Forced (not setdefault): the
+# repo image pins JAX_PLATFORMS=axon (the TPU relay plugin) in the ambient
+# env, and a bare `pytest tests/` must not dial the relay — the relay is
+# single-client and may be down. Set RBT_TEST_PLATFORM to override.
+# The pinning recipe lives in benchkit.apply_cpu_env (also clears
+# PALLAS_AXON_POOL_IPS so test subprocesses skip the relay hook too).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchkit import apply_cpu_env  # noqa: E402
+
+if os.environ.get("RBT_TEST_PLATFORM", "cpu") == "cpu":
+    apply_cpu_env(n_devices=8)
+else:
+    os.environ["JAX_PLATFORMS"] = os.environ["RBT_TEST_PLATFORM"]
 
 import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Belt-and-braces: pytest loads installed plugins BEFORE conftest, and
+    # some of them import jax — which latches the ambient JAX_PLATFORMS
+    # (axon) at import time, making the env override above a no-op and
+    # hanging the first jax.devices() on the dead relay. The config update
+    # still works as long as no backend has been initialized yet.
+    jax.config.update("jax_platforms", "cpu")
 
 # Exact-math tests: JAX's *default* matmul precision may round inputs to
 # bf16 even for f32 arrays, which makes results shape-dependent (full matmul
