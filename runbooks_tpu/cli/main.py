@@ -32,6 +32,27 @@ from runbooks_tpu.k8s import objects as ko
 KIND_ORDER = {"Dataset": 0, "Model": 1, "Server": 2, "Notebook": 3}
 
 
+def use_tui(args) -> bool:
+    """Full-screen TUI when attached to a terminal (reference: every `sub`
+    command runs a bubbletea program); --plain or RBT_NO_TUI=1 opts out,
+    and pipes/CI fall back to the plain printed flow automatically."""
+    if getattr(args, "plain", False) or os.environ.get("RBT_NO_TUI") == "1":
+        return False
+    return sys.stdout.isatty() and sys.stdin.isatty()
+
+
+def run_flow(flow) -> int:
+    """Run a TUI flow to completion; exit code from its final error."""
+    from runbooks_tpu.tui.core import Program
+
+    Program(flow).run()
+    if flow.final_error is not None:
+        # The alt-screen teardown erased the last frame; restate the error.
+        print(f"Error: {flow.final_error}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def context_dir(filename: str) -> str:
     """Build-context directory for -f: the directory itself when -f is a
     directory, else the file's directory."""
@@ -146,6 +167,13 @@ def wait_ready(client, obj: dict, timeout_s: float, quiet=False) -> bool:
 
 def cmd_apply(args) -> int:
     client = make_client(args)
+    if use_tui(args):
+        from runbooks_tpu.tui.flows import ApplyFlow
+
+        return run_flow(ApplyFlow(
+            client, args.filename, args.namespace,
+            build_dir=args.build, wait=args.wait,
+            timeout_s=args.timeout))
     manifests = load_manifests(args.filename, args.namespace)
     if not manifests:
         print(f"no runbooks-tpu manifests found in {args.filename}",
@@ -194,6 +222,11 @@ def _collect_rows(client, kind_filter, name_filter, namespace):
 def cmd_get(args) -> int:
     client = make_client(args)
     kind_filter, name_filter = parse_scope(args.scope)
+    if args.watch and use_tui(args):
+        from runbooks_tpu.tui.flows import GetFlow
+
+        return run_flow(GetFlow(client, args.namespace,
+                                kind_filter or "", name_filter or ""))
     header = ["NAME", "NAMESPACE", "READY", "CONDITIONS"]
     if not args.watch:
         rows = _collect_rows(client, kind_filter, name_filter,
@@ -231,6 +264,10 @@ def cmd_delete(args) -> int:
         if not kind or not name:
             raise SystemExit("usage: rbt delete <kind>/<name> | -f FILE")
         targets = [(kind, name)]
+    if use_tui(args):
+        from runbooks_tpu.tui.flows import DeleteFlow
+
+        return run_flow(DeleteFlow(client, targets, args.namespace))
     for kind, name in targets:
         ok = client.delete(API_VERSION, kind, args.namespace, name)
         print(f"{kind.lower()}s/{name} " + ("deleted" if ok else "not found"))
@@ -257,6 +294,13 @@ def cmd_run(args) -> int:
     `sub run`): package the CWD, create the object (auto-incremented name or
     --replace), wait until it completes."""
     client = make_client(args)
+    if use_tui(args):
+        from runbooks_tpu.tui.flows import RunFlow
+
+        return run_flow(RunFlow(
+            client, args.filename, args.namespace, build_dir=args.build,
+            increment=args.increment, replace=args.replace,
+            timeout_s=args.timeout))
     manifests = load_manifests(args.filename, args.namespace)
     if not manifests:
         print("no manifests found", file=sys.stderr)
@@ -295,6 +339,12 @@ def cmd_serve(args) -> int:
     kind, name = parse_scope(args.scope)
     if kind != "Server" or not name:
         raise SystemExit("usage: rbt serve servers/<name>")
+    if use_tui(args):
+        from runbooks_tpu.tui.flows import ServeFlow
+
+        return run_flow(ServeFlow(client, name, args.namespace,
+                                  local_port=args.port,
+                                  timeout_s=args.timeout))
     obj = client.get(API_VERSION, "Server", args.namespace, name)
     if obj is None:
         raise SystemExit(f"servers/{name} not found")
@@ -310,6 +360,12 @@ def cmd_notebook(args) -> int:
     """Apply/derive a Notebook, upload the workspace, wait, port-forward 8888,
     and sync files back (reference: internal/tui/notebook.go flow)."""
     client = make_client(args)
+    if use_tui(args):
+        from runbooks_tpu.tui.flows import NotebookFlow
+
+        return run_flow(NotebookFlow(
+            client, args.filename, args.namespace, build_dir=args.build,
+            sync=args.sync, timeout_s=args.timeout))
     manifests = load_manifests(args.filename, args.namespace)
     nb = next((m for m in manifests if m["kind"] == "Notebook"), None)
     if nb is None and manifests:
@@ -420,6 +476,8 @@ def build_parser() -> argparse.ArgumentParser:
                                 description="runbooks-tpu dev CLI")
     p.add_argument("-n", "--namespace", default="default")
     p.add_argument("--kubeconfig")
+    p.add_argument("--plain", action="store_true",
+                   help="plain line output instead of the full-screen TUI")
     sub = p.add_subparsers(dest="command", required=True)
 
     def common(sp, filename=True):

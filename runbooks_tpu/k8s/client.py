@@ -236,6 +236,35 @@ class K8sClient:
         except NotFound:
             return False
 
+    def pod_logs(self, namespace: str, name: str,
+                 container: Optional[str] = None, follow: bool = False,
+                 tail_lines: Optional[int] = None):
+        """Stream pod log lines (GET .../pods/{name}/log). Generator of
+        decoded lines; with follow=True it blocks on the HTTP stream until
+        the pod finishes (reference analog: internal/tui/pods.go getLogs
+        via the clientset's follow stream)."""
+        query = []
+        if container:
+            query.append(f"container={container}")
+        if follow:
+            query.append("follow=true")
+        if tail_lines is not None:
+            query.append(f"tailLines={tail_lines}")
+        url = self._url("v1", "Pod", namespace, name, subresource="log",
+                        query="&".join(query))
+        req = urllib.request.Request(
+            url, headers={**self.config.headers, "Accept": "text/plain"})
+        timeout = 3600 if follow else 30
+        try:
+            with urllib.request.urlopen(
+                    req, context=self.config.ssl_ctx, timeout=timeout) as r:
+                for raw in r:
+                    yield raw.decode("utf-8", "replace").rstrip("\n")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return
+            raise
+
     # -- watch ---------------------------------------------------------
 
     def watch(self, api_version: Optional[str] = None,

@@ -84,6 +84,7 @@ class FakeCluster:
         self._rv = itertools.count(1)
         self._uid = itertools.count(1)
         self._subs: List[Tuple[Optional[str], Optional[str], Subscription]] = []
+        self._pod_logs: Dict[Tuple[str, str], List[str]] = {}
 
     # -- reads ---------------------------------------------------------
 
@@ -210,6 +211,24 @@ class FakeCluster:
             if (av is None or av == ko.api_version(obj)) and \
                     (k is None or k == ko.kind(obj)):
                 sub.put(event, obj)
+
+    # -- pod logs ------------------------------------------------------
+
+    def pod_logs(self, namespace: str, name: str,
+                 container: Optional[str] = None, follow: bool = False,
+                 tail_lines: Optional[int] = None):
+        """Yield log lines recorded via set_pod_logs (kubelet stand-in for
+        TUI/log-streaming tests)."""
+        with self._lock:
+            lines = list(self._pod_logs.get((namespace, name), []))
+        if tail_lines is not None:
+            lines = lines[-tail_lines:]
+        yield from lines
+
+    def set_pod_logs(self, namespace: str, name: str, text: str) -> None:
+        with self._lock:
+            self._pod_logs.setdefault((namespace, name), []).extend(
+                text.splitlines())
 
     # -- test helpers (fakeJobComplete / fakePodReady analogs) ---------
 

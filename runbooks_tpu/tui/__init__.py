@@ -1,0 +1,26 @@
+"""Full-screen terminal UI for the rbt dev loop.
+
+Reference analog: internal/tui/ (~2,700 LoC of bubbletea models — notebook,
+run, serve, apply, get, delete flows composed from manifests/upload/
+readiness/pods sub-models). Re-designed rather than translated: the same
+Elm-style model/update/view architecture (it is what makes the reference's
+TUI testable headless, and we keep that property), implemented on the Python
+stdlib — no curses, no external TUI dependency.
+
+Layering:
+
+- ``core``      — message loop (Program), Cmd threads, key/resize input,
+                  alternate-screen renderer.
+- ``widgets``   — spinner, log viewport, ANSI styles, table.
+- ``messages``  — typed messages passed through every update().
+- ``submodels`` — manifests / upload / readiness / pods building blocks
+                  (reference: manifests.go, upload.go, readiness.go, pods.go).
+- ``flows``     — NotebookFlow, RunFlow, ServeFlow, ApplyFlow, DeleteFlow,
+                  GetFlow (reference: notebook.go, run.go, serve.go,
+                  apply.go, delete.go, get.go).
+
+Every flow is driven purely by messages, so tests exercise update loops
+headless (tests/test_tui.py) exactly like bubbletea model tests.
+"""
+
+from runbooks_tpu.tui.core import Program  # noqa: F401

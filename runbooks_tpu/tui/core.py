@@ -1,0 +1,234 @@
+"""Elm-style TUI runtime: Program + message loop + terminal I/O.
+
+Reference analog: the bubbletea runtime the reference's internal/tui models
+run on (tea.Program, tea.Cmd goroutines, tea.KeyMsg/WindowSizeMsg). Same
+architecture, Python stdlib implementation:
+
+- A *model* is any object with ``init(program)``, ``update(msg) -> cmds``,
+  and ``view() -> str``. update() mutates the model and returns an optional
+  list of *commands*.
+- A *command* is a callable taking ``send`` (the program's message sink); it
+  runs on a daemon thread so blocking work (watches, uploads, log streams)
+  never stalls the UI loop. Its return value, if a message, is sent.
+- The program renders ``view()`` into the alternate screen buffer after each
+  message batch, reads keys in raw mode, and emits ~8 Hz ``Tick`` messages
+  for spinners plus ``WindowSize`` on resize.
+
+Headless testability (the property that makes the reference TUI unit-testable
+— bubbletea models are pure state machines) is preserved: tests drive
+``model.update(msg)`` directly and run returned commands synchronously with a
+collecting ``send``; no terminal or threads involved.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+from runbooks_tpu.tui import messages as m
+
+Cmd = Callable[[Callable[[object], None]], Optional[object]]
+
+# Escape-sequence suffixes for special keys (CSI codes after "\x1b[").
+_CSI_KEYS = {"A": "up", "B": "down", "C": "right", "D": "left",
+             "H": "home", "F": "end", "3~": "delete", "5~": "pgup",
+             "6~": "pgdown"}
+_CTRL_KEYS = {3: "ctrl+c", 4: "ctrl+d", 26: "ctrl+z", 12: "ctrl+l",
+              13: "enter", 10: "enter", 9: "tab", 127: "backspace"}
+
+
+def decode_keys(data: bytes) -> List[str]:
+    """Decode a chunk of raw stdin bytes into key names."""
+    keys: List[str] = []
+    i = 0
+    while i < len(data):
+        b = data[i]
+        if b == 0x1B:
+            if data[i + 1:i + 2] == b"[":
+                rest = data[i + 2:i + 6].decode("latin1")
+                matched = False
+                for suffix, name in _CSI_KEYS.items():
+                    if rest.startswith(suffix):
+                        keys.append(name)
+                        i += 2 + len(suffix)
+                        matched = True
+                        break
+                if matched:
+                    continue
+                i += 2  # unknown CSI; skip the introducer
+                continue
+            keys.append("esc")
+            i += 1
+            continue
+        if b in _CTRL_KEYS:
+            keys.append(_CTRL_KEYS[b])
+            i += 1
+            continue
+        if b < 32:
+            keys.append(f"ctrl+{chr(b + 96)}")
+            i += 1
+            continue
+        # Collect one UTF-8 character.
+        width = 1
+        if b >= 0xF0:
+            width = 4
+        elif b >= 0xE0:
+            width = 3
+        elif b >= 0xC0:
+            width = 2
+        keys.append(data[i:i + width].decode("utf-8", "replace"))
+        i += width
+    return keys
+
+
+class Program:
+    """Runs a model against the terminal (tea.Program analog)."""
+
+    def __init__(self, model, fps: float = 8.0,
+                 out=None, interactive: Optional[bool] = None):
+        self.model = model
+        self.fps = fps
+        self.out = out or sys.stdout
+        self._q: "queue.Queue[object]" = queue.Queue()
+        self._quit = threading.Event()
+        self._goodbye = ""
+        self._final_view = ""
+        self.interactive = (self.out.isatty() and sys.stdin.isatty()
+                            if interactive is None else interactive)
+        self._size = shutil.get_terminal_size((100, 32))
+
+    # -- message plumbing --------------------------------------------------
+
+    def send(self, msg: object) -> None:
+        if msg is not None:
+            self._q.put(msg)
+
+    def spawn(self, cmd: Cmd) -> None:
+        """Run a command on a daemon thread; send its result message."""
+        def runner():
+            try:
+                result = cmd(self.send)
+            except BaseException as e:  # surfaced to the model, not lost
+                self.send(m.Error(e))
+                return
+            self.send(result)
+        threading.Thread(target=runner, daemon=True).start()
+
+    def _dispatch(self, msg: object) -> None:
+        if isinstance(msg, m.Quit):
+            self._goodbye = msg.goodbye
+            self._quit.set()
+        cmds = self.model.update(msg)
+        for cmd in cmds or []:
+            self.spawn(cmd)
+
+    # -- terminal I/O ------------------------------------------------------
+
+    def _ticker(self):
+        n = 0
+        while not self._quit.is_set():
+            time.sleep(1.0 / self.fps)
+            n += 1
+            self.send(m.Tick(n))
+            size = shutil.get_terminal_size((100, 32))
+            if size != self._size:
+                self._size = size
+                self.send(m.WindowSize(size.columns, size.lines))
+
+    def _key_reader(self):
+        fd = sys.stdin.fileno()
+        while not self._quit.is_set():
+            try:
+                data = os.read(fd, 64)
+            except OSError:
+                return
+            if not data:
+                return
+            for key in decode_keys(data):
+                self.send(m.Key(key))
+
+    def _render(self, frame: str, prev: str) -> str:
+        if frame == prev:
+            return prev
+        lines = frame.split("\n")
+        max_rows = max(self._size.lines - 1, 4)
+        if len(lines) > max_rows:
+            lines = lines[-max_rows:]
+        buf = "\x1b[H" + "\r\n".join(
+            line + "\x1b[K" for line in lines) + "\x1b[0J"
+        self.out.write(buf)
+        self.out.flush()
+        return frame
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> str:
+        """Run to completion; returns the goodbye string."""
+        self.send(m.WindowSize(self._size.columns, self._size.lines))
+        if not self.interactive:
+            return self._run_plain()
+
+        import termios
+        import tty
+        fd = sys.stdin.fileno()
+        saved = termios.tcgetattr(fd)
+        self.out.write("\x1b[?1049h\x1b[?25l\x1b[2J\x1b[H")  # alt screen
+        self.out.flush()
+        try:
+            tty.setcbreak(fd)
+            threading.Thread(target=self._ticker, daemon=True).start()
+            threading.Thread(target=self._key_reader, daemon=True).start()
+            for cmd in self.model.init(self) or []:
+                self.spawn(cmd)
+            prev = ""
+            while not self._quit.is_set():
+                try:
+                    msg = self._q.get(timeout=0.25)
+                except queue.Empty:
+                    continue
+                self._dispatch(msg)
+                while True:  # drain the batch before re-rendering
+                    try:
+                        self._dispatch(self._q.get_nowait())
+                    except queue.Empty:
+                        break
+                prev = self._render(self.model.view(), prev)
+            self._final_view = self.model.view()
+        finally:
+            termios.tcsetattr(fd, termios.TCSADRAIN, saved)
+            self.out.write("\x1b[?25h\x1b[?1049l")  # restore screen
+            self.out.flush()
+        if self._goodbye:
+            print(self._goodbye, file=self.out)
+        return self._goodbye
+
+    def _run_plain(self) -> str:
+        """Non-TTY fallback: run the same model, print view diffs as plain
+        lines (useful under pipes/CI where a full-screen UI is nonsense)."""
+        threading.Thread(target=self._ticker, daemon=True).start()
+        for cmd in self.model.init(self) or []:
+            self.spawn(cmd)
+        prev_lines: List[str] = []
+        while not self._quit.is_set():
+            try:
+                msg = self._q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if isinstance(msg, (m.Tick, m.Key)):
+                continue  # no spinners/keys when piped
+            self._dispatch(msg)
+            from runbooks_tpu.tui.widgets import strip_ansi
+            lines = [ln for ln in strip_ansi(self.model.view()).split("\n")
+                     if ln.strip()]
+            for ln in lines:
+                if ln not in prev_lines:
+                    print(ln, file=self.out)
+            prev_lines = lines
+        if self._goodbye:
+            print(self._goodbye, file=self.out)
+        return self._goodbye

@@ -1,0 +1,593 @@
+"""Top-level TUI flows: notebook, run, serve, apply, delete, get.
+
+Reference analog: internal/tui/{notebook,run,serve,apply,delete,get}.go.
+Each flow is a model composing the submodels, driven purely by messages, so
+the whole state machine is testable headless (tests/test_tui.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from runbooks_tpu.api.types import API_VERSION, KINDS
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.tui import messages as m
+from runbooks_tpu.tui.submodels import (
+    COMPLETED,
+    IN_PROGRESS,
+    ManifestsModel,
+    PodsModel,
+    ReadinessModel,
+    UploadModel,
+    apply_cmd,
+    delete_cmd,
+    load_manifests_cmd,
+    port_forward_cmd,
+    suspend_cmd,
+    sync_files_cmd,
+    upload_cmd,
+    wait_ready_cmd,
+    watch_pods_cmd,
+)
+from runbooks_tpu.tui.widgets import (
+    CHECK,
+    Spinner,
+    bold,
+    dim,
+    error_style,
+    help_style,
+    render_table,
+)
+
+
+def watch_objects_cmd(client, kind: str, namespace: str, poll_s: float = 0.5):
+    """Forward watch events for one kind into WatchEvent messages
+    (reference: get.go watchCmd)."""
+    def cmd(send):
+        sub = client.watch(API_VERSION, kind)
+        while True:
+            got = sub.poll(timeout=poll_s)
+            if got is None:
+                continue
+            event, obj = got
+            if ko.namespace(obj) == namespace:
+                send(m.WatchEvent(event, obj))
+    from runbooks_tpu.tui.submodels import _long_running
+    return _long_running(cmd)
+
+
+def needs_upload(obj: dict) -> bool:
+    build = ko.deep_get(obj, "spec", "build", default={}) or {}
+    return "upload" in build
+
+
+def _build_context(build_dir, path: str) -> str:
+    """Explicit --build dir, else the manifest's directory."""
+    from runbooks_tpu.cli.main import context_dir
+    return build_dir or context_dir(path)
+
+
+class _BaseFlow:
+    """Shared error/quit handling (reference: the repeated error/quitting
+    arms in every flow's Update)."""
+
+    def __init__(self):
+        self.final_error: Optional[BaseException] = None
+        self.goodbye = ""
+
+    def handle_common(self, msg) -> Optional[list]:
+        """Returns a cmd list when the message was consumed, else None."""
+        if isinstance(msg, m.Error):
+            self.final_error = msg.error
+            return [lambda send: m.Quit()]
+        if isinstance(msg, m.Key) and msg.key in ("q", "ctrl+c"):
+            return [lambda send: m.Quit()]
+        return None
+
+    def footer(self) -> str:
+        if self.final_error is not None:
+            return error_style(f"Error: {self.final_error}") + "\n" + \
+                help_style('Press "q" to quit')
+        return help_style('Press "q" to quit')
+
+
+class NotebookFlow(_BaseFlow):
+    """The notebook dev loop: manifests → upload → ready → sync +
+    port-forward, with q → suspend/delete/cancel keys (reference:
+    notebook.go:65-241)."""
+
+    def __init__(self, client, path: str, namespace: str,
+                 build_dir: Optional[str] = None, sync: bool = True,
+                 timeout_s: float = 720.0, pf_runner=None):
+        super().__init__()
+        self.client = client
+        self.path = path
+        self.namespace = namespace
+        self.build_dir = build_dir
+        self.sync = sync
+        self.timeout_s = timeout_s
+        self.pf_runner = pf_runner  # injectable for tests
+        self.manifests = ManifestsModel(path)
+        self.upload = UploadModel()
+        self.readiness = ReadinessModel()
+        self.pods = PodsModel(client)
+        self.notebook: Optional[dict] = None
+        self.syncing = None  # None | IN_PROGRESS
+        self.current_sync_file = ""
+        self.last_sync_error: Optional[BaseException] = None
+        self.local_url = ""
+        self.quitting = False
+
+    def init(self, program=None) -> list:
+        return [load_manifests_cmd(self.path, self.namespace,
+                                   kinds=["Notebook", "Model", "Dataset"])]
+
+    def _derive_notebook(self, objs: List[dict]) -> Optional[dict]:
+        """Notebook from the manifests, else derived from another object
+        (reference: client/notebook.go NotebookForObject)."""
+        nb = next((o for o in objs if o["kind"] == "Notebook"), None)
+        if nb is None and objs:
+            src = objs[0]
+            nb = {
+                "apiVersion": API_VERSION, "kind": "Notebook",
+                "metadata": {"name": ko.name(src),
+                             "namespace": self.namespace},
+                "spec": {k: v for k, v in src.get("spec", {}).items()
+                         if k in ("image", "build", "env", "params",
+                                  "resources", "model", "dataset")},
+            }
+        if nb is not None:
+            nb.setdefault("spec", {})["suspend"] = False
+        return nb
+
+    def update(self, msg) -> Optional[list]:
+        common = self.handle_common_notebook(msg)
+        if common is not None:
+            return common
+
+        self.manifests.update(msg)
+        self.upload.update(msg)
+        self.readiness.update(msg)
+        pod_cmds = self.pods.update(msg) or []
+
+        cmds: list = list(pod_cmds)
+        if isinstance(msg, m.ManifestsLoaded):
+            nb = self._derive_notebook(msg.objects)
+            if nb is None:
+                self.final_error = RuntimeError(
+                    f"no notebook (or derivable object) in {self.path}")
+                return cmds + [lambda send: m.Quit()]
+            self.notebook = nb
+            self.upload.obj_name = ko.name(nb)
+            if needs_upload(nb) or self.build_dir:
+                cmds.append(upload_cmd(self.client, nb,
+                                       _build_context(self.build_dir, self.path)))
+            else:
+                cmds.append(apply_cmd(self.client, nb))
+        elif isinstance(msg, (m.TarballUploaded, m.Applied)):
+            self.notebook = msg.obj
+            self.readiness.obj = msg.obj
+            cmds.append(wait_ready_cmd(self.client, msg.obj,
+                                       timeout_s=self.timeout_s))
+            cmds.append(watch_pods_cmd(self.client, msg.obj))
+        elif isinstance(msg, m.ObjectReady):
+            self.notebook = msg.obj
+            pod = f"{ko.name(msg.obj)}-notebook"
+            if self.sync and self.syncing is None:
+                self.syncing = IN_PROGRESS
+                cmds.append(sync_files_cmd(
+                    pod, self.namespace, _build_context(None, self.path)))
+            cmds.append(port_forward_cmd(
+                f"pod/{pod}", 8888, 8888, self.namespace,
+                runner=self.pf_runner))
+        elif isinstance(msg, m.FileSync):
+            self.current_sync_file = "" if msg.complete else msg.file
+            self.last_sync_error = msg.error
+        elif isinstance(msg, m.PortForwardReady):
+            self.local_url = "http://localhost:8888?token=default"
+        elif isinstance(msg, m.Suspended):
+            if msg.error:
+                self.final_error = msg.error
+            else:
+                self.goodbye = "Notebook suspended."
+            cmds.append(lambda send: m.Quit(self.goodbye))
+        elif isinstance(msg, m.Deleted):
+            if msg.error:
+                self.final_error = msg.error
+            else:
+                self.goodbye = "Notebook deleted."
+            cmds.append(lambda send: m.Quit(self.goodbye))
+        return cmds
+
+    def handle_common_notebook(self, msg) -> Optional[list]:
+        """q opens a confirm state with s(uspend)/d(elete)/esc (reference:
+        notebook.go:146-170)."""
+        if isinstance(msg, m.Error):
+            self.final_error = msg.error
+            self.quitting = True
+            return []
+        if not isinstance(msg, m.Key):
+            return None
+        if self.quitting:
+            if msg.key == "esc":
+                if self.final_error is None:
+                    self.quitting = False
+                else:  # nothing to go back to — exit
+                    return [lambda send: m.Quit()]
+            elif msg.key == "s" and self.notebook is not None:
+                return [suspend_cmd(self.client, self.notebook)]
+            elif msg.key == "d" and self.notebook is not None:
+                return [delete_cmd(self.client, self.notebook)]
+            elif msg.key in ("q", "ctrl+c"):
+                return [lambda send: m.Quit()]
+            return []
+        if msg.key in ("q", "ctrl+c"):
+            self.quitting = True
+            return []
+        return None
+
+    def view(self) -> str:
+        if self.goodbye:
+            return self.goodbye + "\n"
+        if self.quitting:
+            if self.final_error is not None:
+                return error_style(f"Error: {self.final_error}") + "\n" + \
+                    help_style('Press "q" to quit')
+            return "Quitting...\n" + help_style(
+                'Press "s" to suspend, "d" to delete, "ESC" to cancel')
+        v = self.manifests.view() + self.upload.view() + \
+            self.readiness.view() + self.pods.view()
+        if self.syncing == IN_PROGRESS:
+            if self.current_sync_file:
+                v += f"Syncing from notebook: {self.current_sync_file}\n"
+            else:
+                v += "Watching for files to sync...\n"
+            if self.last_sync_error is not None:
+                v += error_style(
+                    f"Sync failed: {self.last_sync_error}") + "\n"
+        if self.local_url:
+            v += f"\nNotebook URL: {bold(self.local_url)}\n"
+        v += help_style('Press "q" to quit')
+        return v
+
+
+class RunFlow(_BaseFlow):
+    """Create-with-upload batch flow; quits when ready (reference: run.go).
+    increment/replace name semantics match `rbt run -i/-r`."""
+
+    def __init__(self, client, path: str, namespace: str,
+                 build_dir: Optional[str] = None, increment: bool = False,
+                 replace: bool = False, timeout_s: float = 720.0):
+        super().__init__()
+        self.client = client
+        self.path = path
+        self.namespace = namespace
+        self.build_dir = build_dir
+        self.increment = increment
+        self.replace = replace
+        self.timeout_s = timeout_s
+        self.manifests = ManifestsModel(path)
+        self.upload = UploadModel()
+        self.readiness = ReadinessModel()
+        self.pods = PodsModel(client)
+        self.obj: Optional[dict] = None
+
+    def init(self, program=None) -> list:
+        return [load_manifests_cmd(self.path, self.namespace)]
+
+    def _prepare_cmd(self, obj: dict):
+        """Name auto-increment / replace, then upload-or-apply (reference:
+        common.go createWithUpload name auto-increment regex)."""
+        client = self.client
+
+        def cmd(send):
+            kind, ns, base = obj["kind"], ko.namespace(obj), ko.name(obj)
+            if self.replace:
+                client.delete(API_VERSION, kind, ns, base)
+            elif self.increment:
+                from runbooks_tpu.cli.main import _auto_increment_name
+                obj["metadata"]["name"] = _auto_increment_name(
+                    client, kind, ns, base)
+            if needs_upload(obj) or self.build_dir:
+                return upload_cmd(client, obj,
+                                  _build_context(self.build_dir, self.path))(send)
+            return apply_cmd(client, obj)(send)
+        return cmd
+
+    def update(self, msg) -> Optional[list]:
+        common = self.handle_common(msg)
+        if common is not None:
+            return common
+        self.manifests.update(msg)
+        self.upload.update(msg)
+        self.readiness.update(msg)
+        cmds: list = list(self.pods.update(msg) or [])
+        if isinstance(msg, m.ManifestsLoaded):
+            if not msg.objects:
+                self.final_error = RuntimeError(
+                    f"no manifests found in {self.path}")
+                return cmds + [lambda send: m.Quit()]
+            self.obj = msg.objects[0]
+            self.upload.obj_name = ko.name(self.obj)
+            cmds.append(self._prepare_cmd(self.obj))
+        elif isinstance(msg, (m.TarballUploaded, m.Applied)):
+            self.obj = msg.obj
+            self.readiness.obj = msg.obj
+            cmds.append(wait_ready_cmd(self.client, msg.obj,
+                                       timeout_s=self.timeout_s))
+            cmds.append(watch_pods_cmd(self.client, msg.obj))
+        elif isinstance(msg, m.ObjectReady):
+            self.obj = msg.obj
+            self.goodbye = (f"{ko.kind(msg.obj)}/{ko.name(msg.obj)} ready")
+            cmds.append(lambda send: m.Quit(self.goodbye))
+        return cmds
+
+    def view(self) -> str:
+        if self.goodbye:
+            return self.goodbye + "\n"
+        v = self.manifests.view() + self.upload.view() + \
+            self.readiness.view() + self.pods.view()
+        v += self.footer()
+        return v
+
+
+class ServeFlow(_BaseFlow):
+    """Wait for a Server, port-forward, print the URL (reference:
+    serve.go:203-289)."""
+
+    def __init__(self, client, name: str, namespace: str,
+                 local_port: int = 8000, timeout_s: float = 720.0,
+                 pf_runner=None):
+        super().__init__()
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.local_port = local_port
+        self.timeout_s = timeout_s
+        self.pf_runner = pf_runner
+        self.readiness = ReadinessModel()
+        self.pods = PodsModel(client)
+        self.local_url = ""
+        self.server: Optional[dict] = None
+
+    def init(self, program=None) -> list:
+        def fetch(send):
+            obj = self.client.get(API_VERSION, "Server", self.namespace,
+                                  self.name)
+            if obj is None:
+                return m.Error(RuntimeError(
+                    f"servers/{self.name} not found"))
+            return m.Applied(obj)
+        return [fetch]
+
+    def update(self, msg) -> Optional[list]:
+        common = self.handle_common(msg)
+        if common is not None:
+            return common
+        self.readiness.update(msg)
+        cmds: list = list(self.pods.update(msg) or [])
+        if isinstance(msg, m.Applied):
+            self.server = msg.obj
+            self.readiness.obj = msg.obj
+            cmds.append(wait_ready_cmd(self.client, msg.obj,
+                                       timeout_s=self.timeout_s))
+            cmds.append(watch_pods_cmd(self.client, msg.obj))
+        elif isinstance(msg, m.ObjectReady):
+            self.server = msg.obj
+            cmds.append(port_forward_cmd(
+                f"service/{self.name}", self.local_port, 80,
+                self.namespace, runner=self.pf_runner))
+        elif isinstance(msg, m.PortForwardReady):
+            self.local_url = f"http://localhost:{self.local_port}"
+        return cmds
+
+    def view(self) -> str:
+        v = self.readiness.view() + self.pods.view()
+        if self.local_url:
+            v += f"\nServer URL: {bold(self.local_url)}\n"
+            v += dim(f"  try: curl {self.local_url}/v1/completions "
+                     '-d \'{"prompt": "..."}\'') + "\n"
+        v += self.footer()
+        return v
+
+
+class ApplyFlow(_BaseFlow):
+    """Apply many manifests with per-object readiness checklists
+    (reference: apply.go per-object spinners)."""
+
+    def __init__(self, client, path: str, namespace: str,
+                 build_dir: Optional[str] = None, wait: bool = True,
+                 timeout_s: float = 720.0):
+        super().__init__()
+        self.client = client
+        self.path = path
+        self.namespace = namespace
+        self.build_dir = build_dir
+        self.wait = wait
+        self.timeout_s = timeout_s
+        self.manifests = ManifestsModel(path)
+        self.upload = UploadModel()
+        self.ready: Dict[str, ReadinessModel] = {}
+        self.expected = 0
+
+    def init(self, program=None) -> list:
+        return [load_manifests_cmd(self.path, self.namespace)]
+
+    def _key(self, obj: dict) -> str:
+        return f"{obj['kind']}/{ko.name(obj)}"
+
+    def update(self, msg) -> Optional[list]:
+        common = self.handle_common(msg)
+        if common is not None:
+            return common
+        self.manifests.update(msg)
+        self.upload.update(msg)
+        if isinstance(msg, m.Tick):
+            for r in self.ready.values():
+                r.update(msg)
+        cmds: list = []
+        if isinstance(msg, m.ManifestsLoaded):
+            if not msg.objects:
+                self.final_error = RuntimeError(
+                    f"no manifests found in {self.path}")
+                return [lambda send: m.Quit()]
+            self.expected = len(msg.objects)
+            for obj in msg.objects:
+                if needs_upload(obj) or self.build_dir:
+                    cmds.append(upload_cmd(self.client, obj,
+                                           _build_context(self.build_dir, self.path)))
+                else:
+                    cmds.append(apply_cmd(self.client, obj))
+        elif isinstance(msg, (m.TarballUploaded, m.Applied)):
+            key = self._key(msg.obj)
+            self.ready[key] = ReadinessModel(msg.obj)
+            if self.wait:
+                cmds.append(wait_ready_cmd(self.client, msg.obj,
+                                       timeout_s=self.timeout_s))
+            else:
+                self.ready[key].waiting = COMPLETED
+        elif isinstance(msg, (m.ObjectUpdate, m.ObjectReady)):
+            key = self._key(msg.obj)
+            if key in self.ready:
+                self.ready[key].update(msg)
+            if isinstance(msg, m.ObjectReady) or not self.wait:
+                if (len(self.ready) == self.expected and all(
+                        r.waiting == COMPLETED
+                        for r in self.ready.values())):
+                    self.goodbye = f"{self.expected} object(s) ready"
+                    cmds.append(lambda send: m.Quit(self.goodbye))
+        if not self.wait and self.expected and \
+                len(self.ready) == self.expected and not self.goodbye:
+            self.goodbye = f"{self.expected} object(s) applied"
+            cmds.append(lambda send: m.Quit(self.goodbye))
+        return cmds
+
+    def view(self) -> str:
+        v = self.manifests.view() + self.upload.view()
+        for key in sorted(self.ready):
+            v += self.ready[key].view()
+        v += self.footer()
+        return v
+
+
+class DeleteFlow(_BaseFlow):
+    """Delete objects with progress marks (reference: delete.go)."""
+
+    def __init__(self, client, targets: List[tuple], namespace: str):
+        super().__init__()
+        self.client = client
+        # Dedup (kind, name) pairs: completion is tracked in a dict keyed by
+        # kind/name, so duplicate manifest docs would otherwise never reach
+        # len(targets) and the flow would spin forever.
+        self.targets = list(dict.fromkeys(targets))
+        self.namespace = namespace
+        self.done: Dict[str, bool] = {}
+        self.spinner = Spinner()
+
+    def init(self, program=None) -> list:
+        cmds = []
+        for kind, name in self.targets:
+            obj = {"apiVersion": API_VERSION, "kind": kind,
+                   "metadata": {"name": name, "namespace": self.namespace}}
+
+            def make(obj=obj, kind=kind, name=name):
+                def cmd(send):
+                    self_client_deleted = self.client.delete(
+                        API_VERSION, kind, self.namespace, name)
+                    send(m.WatchEvent(
+                        "DELETED" if self_client_deleted else "ABSENT", obj))
+                    return None
+                return cmd
+            cmds.append(make())
+        return cmds
+
+    def update(self, msg) -> Optional[list]:
+        common = self.handle_common(msg)
+        if common is not None:
+            return common
+        if isinstance(msg, m.Tick):
+            self.spinner.tick()
+        elif isinstance(msg, m.WatchEvent):
+            key = f"{msg.obj['kind'].lower()}s/{ko.name(msg.obj)}"
+            self.done[key] = msg.event == "DELETED"
+            if len(self.done) == len(self.targets):
+                self.goodbye = f"{len(self.targets)} object(s) deleted"
+                return [lambda send: m.Quit(self.goodbye)]
+        return None
+
+    def view(self) -> str:
+        v = ""
+        for kind, name in self.targets:
+            key = f"{kind.lower()}s/{name}"
+            if key in self.done:
+                mark = CHECK if self.done[key] else dim("absent")
+                v += f"{mark} {key}\n"
+            else:
+                v += f"{self.spinner.view()} {key}\n"
+        v += self.footer()
+        return v
+
+
+class GetFlow(_BaseFlow):
+    """Live watch-based table of all kinds with ready marks (reference:
+    get.go:118-180, scope syntax :228-266)."""
+
+    def __init__(self, client, namespace: str, kind_filter: str = "",
+                 name_filter: str = ""):
+        super().__init__()
+        self.client = client
+        self.namespace = namespace
+        self.kind_filter = kind_filter
+        self.name_filter = name_filter
+        # kind -> name -> obj
+        self.objects: Dict[str, Dict[str, dict]] = {k: {} for k in KINDS}
+        self.spinner = Spinner()
+        self.started = time.strftime("%H:%M:%S")
+
+    def init(self, program=None) -> list:
+        kinds = [self.kind_filter] if self.kind_filter else list(KINDS)
+        return [watch_objects_cmd(self.client, k, self.namespace)
+                for k in kinds]
+
+    def update(self, msg) -> Optional[list]:
+        common = self.handle_common(msg)
+        if common is not None:
+            return common
+        if isinstance(msg, m.Tick):
+            self.spinner.tick()
+        elif isinstance(msg, m.WatchEvent):
+            obj = msg.obj
+            kind, name = ko.kind(obj), ko.name(obj)
+            if self.name_filter and name != self.name_filter:
+                return None
+            if msg.event == "DELETED":
+                self.objects.get(kind, {}).pop(name, None)
+            else:
+                self.objects.setdefault(kind, {})[name] = obj
+        return None
+
+    def view(self) -> str:
+        rows = []
+        total = 0
+        for kind in KINDS:
+            for name in sorted(self.objects.get(kind, {})):
+                obj = self.objects[kind][name]
+                total += 1
+                ready = ko.deep_get(obj, "status", "ready")
+                mark = CHECK if ready else self.spinner.view()
+                conds = ko.deep_get(obj, "status", "conditions",
+                                    default=[]) or []
+                summary = ",".join(
+                    ("+" if c.get("status") == "True" else "-") +
+                    str(c.get("type")) for c in conds)
+                rows.append([f"{kind.lower()}s/{name}", mark,
+                             summary or dim("pending")])
+        v = dim(f"watching since {self.started} — ctrl-c or q to exit") + "\n"
+        if rows:
+            v += render_table(["NAME", "READY", "CONDITIONS"], rows) + "\n"
+        else:
+            v += dim("(no resources yet)") + "\n"
+        v += f"\nTotal: {total}\n"
+        v += self.footer()
+        return v

@@ -1,0 +1,146 @@
+"""Typed messages flowing through TUI update loops.
+
+Reference analog: the `*Msg` structs scattered through internal/tui/*.go
+(objectUpdateMsg, objectReadyMsg, podWatchMsg, podLogsMsg, tarballUploadedMsg,
+notebookFileSyncMsg, portForwardReadyMsg, localURLMsg, suspendedMsg,
+deletedMsg, watchMsg...). Centralized here because Python has no package-level
+private structs and the flows/submodels/tests all import them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class Tick:
+    """Periodic heartbeat (~8 Hz) driving spinners."""
+    n: int = 0
+
+
+@dataclass
+class Key:
+    """A key press ('a', 'enter', 'esc', 'ctrl+c', 'up', ...)."""
+    key: str
+
+
+@dataclass
+class WindowSize:
+    width: int
+    height: int
+
+
+@dataclass
+class Error:
+    error: BaseException
+
+
+@dataclass
+class Quit:
+    """Request the program to exit after the next render."""
+    goodbye: str = ""
+
+
+# -- object lifecycle -------------------------------------------------------
+
+@dataclass
+class ManifestsLoaded:
+    """Manifest discovery finished (reference: manifestsModel)."""
+    objects: list
+
+
+@dataclass
+class ManifestSelected:
+    """The flow's primary object was chosen from the manifests."""
+    obj: Dict[str, Any]
+
+
+@dataclass
+class UploadProgress:
+    """Tarball prep/upload progress line (reference: uploadModel)."""
+    obj_name: str
+    message: str
+
+
+@dataclass
+class TarballUploaded:
+    """Upload handshake complete; obj is the updated object."""
+    obj: Dict[str, Any]
+
+
+@dataclass
+class Applied:
+    """A (non-upload) object was applied/created."""
+    obj: Dict[str, Any]
+
+
+@dataclass
+class ObjectUpdate:
+    """Fresh copy of the tracked object (conditions may have changed)."""
+    obj: Dict[str, Any]
+
+
+@dataclass
+class ObjectReady:
+    """status.ready went true."""
+    obj: Dict[str, Any]
+
+
+@dataclass
+class Suspended:
+    error: Optional[BaseException] = None
+
+
+@dataclass
+class Deleted:
+    error: Optional[BaseException] = None
+
+
+# -- pods / logs ------------------------------------------------------------
+
+@dataclass
+class PodWatch:
+    """A pod appeared/changed/vanished (reference: podWatchMsg)."""
+    event: str  # ADDED | MODIFIED | DELETED
+    pod: Dict[str, Any]
+
+
+@dataclass
+class PodLogs:
+    """One or more log lines from a pod container (reference: podLogsMsg)."""
+    role: str
+    name: str
+    text: str
+
+
+# -- notebook dev-loop extras ----------------------------------------------
+
+@dataclass
+class FileSync:
+    """File-sync progress (reference: notebookFileSyncMsg). ``removed``
+    marks a local deletion mirrored from the pod, not a pull."""
+    file: str = ""
+    complete: bool = False
+    error: Optional[BaseException] = None
+    removed: bool = False
+
+
+@dataclass
+class PortForwardReady:
+    local: int
+    remote: int
+
+
+@dataclass
+class LocalURL:
+    url: str
+
+
+# -- get (watch table) ------------------------------------------------------
+
+@dataclass
+class WatchEvent:
+    """A watch event for the get table (reference: watchMsg)."""
+    event: str  # ADDED | MODIFIED | DELETED
+    obj: Dict[str, Any] = field(default_factory=dict)

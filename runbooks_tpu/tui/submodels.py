@@ -1,0 +1,373 @@
+"""Composable TUI sub-models: manifests, upload, readiness, pods.
+
+Reference analog: internal/tui/manifests.go, upload.go, readiness.go,
+pods.go — the building blocks every flow composes. Each is a self-contained
+model (init/update/view) plus the commands (thread bodies) that feed it
+messages.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from runbooks_tpu.api.types import API_VERSION
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.tui import messages as m
+from runbooks_tpu.tui.widgets import (
+    CHECK,
+    XMARK,
+    Spinner,
+    Viewport,
+    bold,
+    dim,
+    error_style,
+)
+
+IN_PROGRESS, COMPLETED = "in_progress", "completed"
+
+def _long_running(cmd):
+    """Tag a command that polls/streams until cancelled; the synchronous
+    test pump (tests/test_tui.py run_cmds) skips these, while Program just
+    runs them on daemon threads."""
+    cmd.long_running = True
+    return cmd
+
+
+
+# ---------------------------------------------------------------------------
+# Commands (thread bodies). Each takes the extra context it needs and returns
+# a Cmd: a callable of (send) used by Program.spawn or run inline by tests.
+# ---------------------------------------------------------------------------
+
+def load_manifests_cmd(path: str, namespace: str,
+                       kinds: Optional[List[str]] = None):
+    """Discover manifests (reference: manifests.go resolve path/URL->objects)."""
+    def cmd(send):
+        from runbooks_tpu.cli.main import load_manifests
+        objs = load_manifests(path, namespace)
+        if kinds:
+            objs = [o for o in objs if o["kind"] in kinds]
+        return m.ManifestsLoaded(objs)
+    return cmd
+
+
+def upload_cmd(client, obj: dict, build_dir: str):
+    """Tarball + signed-URL handshake (reference: upload.go + common.go)."""
+    def cmd(send):
+        from runbooks_tpu.utils.upload import upload_build_context
+        name = ko.name(obj)
+        updated = upload_build_context(
+            client, obj, build_dir,
+            progress=lambda msg: send(m.UploadProgress(name, msg)))
+        return m.TarballUploaded(updated)
+    return cmd
+
+
+def apply_cmd(client, obj: dict, field_manager: str = "rbt-cli"):
+    def cmd(send):
+        return m.Applied(client.apply(obj, field_manager))
+    return cmd
+
+
+def wait_ready_cmd(client, obj: dict, poll_s: float = 0.5,
+                   timeout_s: float = 7200.0):
+    """Poll until status.ready (reference: client.WaitReady + readiness.go);
+    emits ObjectUpdate on every change and ObjectReady at the end."""
+    kind, ns, name = ko.kind(obj), ko.namespace(obj), ko.name(obj)
+
+    def cmd(send):
+        last_rv = None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            cur = client.get(API_VERSION, kind, ns, name)
+            if cur is not None:
+                rv = ko.deep_get(cur, "metadata", "resourceVersion")
+                if rv != last_rv:
+                    last_rv = rv
+                    send(m.ObjectUpdate(cur))
+                if ko.deep_get(cur, "status", "ready"):
+                    return m.ObjectReady(cur)
+            time.sleep(poll_s)
+        return m.Error(TimeoutError(f"{kind}/{name} not ready after "
+                                    f"{timeout_s:.0f}s"))
+    return _long_running(cmd)
+
+
+def watch_pods_cmd(client, obj: dict, poll_s: float = 1.0):
+    """Stream PodWatch events for pods labeled {kind}={name} (reference:
+    pods.go watchPods). Uses the client's watch stream when available and
+    falls back to list-polling (the real REST client and the fake both
+    expose watch(); polling covers exotic clients)."""
+    kind, ns, name = ko.kind(obj).lower(), ko.namespace(obj), ko.name(obj)
+
+    def matches(pod: dict) -> bool:
+        return (ko.namespace(pod) == ns
+                and ko.labels(pod).get(kind) == name)
+
+    def cmd(send):
+        watch = getattr(client, "watch", None)
+        if watch is not None:
+            sub = client.watch("v1", "Pod")
+            while True:
+                got = sub.poll(timeout=poll_s)
+                if got is None:
+                    continue
+                event, pod = got
+                if matches(pod):
+                    send(m.PodWatch(event, pod))
+        else:  # pragma: no cover - all shipped clients have watch()
+            seen: Dict[str, str] = {}
+            while True:
+                for pod in client.list("v1", "Pod", namespace=ns,
+                                       label_selector={kind: name}):
+                    rv = ko.deep_get(pod, "metadata", "resourceVersion")
+                    ev = "ADDED" if ko.name(pod) not in seen else "MODIFIED"
+                    if seen.get(ko.name(pod)) != rv:
+                        seen[ko.name(pod)] = rv
+                        send(m.PodWatch(ev, pod))
+                time.sleep(poll_s)
+    return _long_running(cmd)
+
+
+def stream_logs_cmd(client, pod: dict, container: Optional[str] = None):
+    """Follow one pod's logs into PodLogs messages (reference: pods.go
+    getLogs via the clientset log stream)."""
+    ns, name = ko.namespace(pod), ko.name(pod)
+    role = ko.labels(pod).get("role", "run")
+
+    def cmd(send):
+        try:
+            for line in client.pod_logs(ns, name, container=container,
+                                        follow=True):
+                send(m.PodLogs(role, name, line))
+        except Exception as e:
+            # A log stream ending (idle-timeout, container restart, 400
+            # during churn) must not kill the whole flow — the pod itself
+            # is fine. Surface it in the viewport instead.
+            send(m.PodLogs(role, name, f"(log stream ended: {e})"))
+    return cmd
+
+
+def suspend_cmd(client, obj: dict):
+    """Suspend a Notebook via a dedicated field manager owning only
+    spec.suspend (same SSA reasoning as cli.cmd_suspend)."""
+    def cmd(send):
+        try:
+            client.apply({"apiVersion": API_VERSION, "kind": ko.kind(obj),
+                          "metadata": {"name": ko.name(obj),
+                                       "namespace": ko.namespace(obj)},
+                          "spec": {"suspend": True}}, "rbt-cli-suspend")
+        except BaseException as e:
+            return m.Suspended(e)
+        return m.Suspended()
+    return cmd
+
+
+def delete_cmd(client, obj: dict):
+    def cmd(send):
+        try:
+            client.delete(API_VERSION, ko.kind(obj), ko.namespace(obj),
+                          ko.name(obj))
+        except BaseException as e:
+            return m.Deleted(e)
+        return m.Deleted()
+    return cmd
+
+
+def port_forward_cmd(target: str, local: int, remote: int, namespace: str,
+                     runner: Optional[Callable] = None):
+    """kubectl port-forward with exponential backoff (reference:
+    portforward.go retry loop). `runner` is injectable for tests."""
+    def default_runner(cmd_argv):
+        import subprocess
+        return subprocess.call(
+            cmd_argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    run = runner or default_runner
+
+    def cmd(send):
+        backoff = 1.0
+        argv = ["kubectl", "port-forward", "-n", namespace, target,
+                f"{local}:{remote}"]
+        for _ in range(8):
+            send(m.PortForwardReady(local, remote))
+            try:
+                rc = run(argv)
+            except FileNotFoundError:
+                return m.Error(RuntimeError(
+                    "kubectl not found on PATH (needed for port-forward)"))
+            if rc == 0:
+                return None
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 30.0)
+        return m.Error(RuntimeError(f"port-forward to {target} kept failing"))
+    return cmd
+
+
+def sync_files_cmd(pod: str, namespace: str, local_dir: str):
+    """Notebook file sync: run nbwatch in the pod, copy changed files back
+    (reference: client/sync.go); emits FileSync progress messages."""
+    def cmd(send):
+        from runbooks_tpu.utils.sync import sync_loop
+        try:
+            sync_loop(pod, namespace, local_dir,
+                      on_event=lambda f, complete, err, removed=False: send(
+                          m.FileSync(f, complete, err, removed)))
+        except BaseException as e:
+            send(m.FileSync(error=e))
+        return None
+    return _long_running(cmd)
+
+
+# ---------------------------------------------------------------------------
+# Sub-models
+# ---------------------------------------------------------------------------
+
+class ReadinessModel:
+    """Live condition checklist (reference: readiness.go:70-101)."""
+
+    def __init__(self, obj: Optional[dict] = None):
+        self.obj = obj
+        self.waiting = IN_PROGRESS
+        self.spinner = Spinner()
+
+    def update(self, msg) -> None:
+        if isinstance(msg, m.Tick):
+            self.spinner.tick()
+        elif isinstance(msg, m.ObjectUpdate):
+            self.obj = msg.obj
+        elif isinstance(msg, m.ObjectReady):
+            self.obj = msg.obj
+            self.waiting = COMPLETED
+
+    def view(self) -> str:
+        if self.obj is None:
+            return ""
+        kind, name = ko.kind(self.obj), ko.name(self.obj)
+        if self.waiting == COMPLETED:
+            return f"{bold(kind)} ({name}): Ready\n"
+        v = f"{bold(kind)} ({name}): {self.spinner.view()}\n"
+        conds = ko.deep_get(self.obj, "status", "conditions",
+                            default=[]) or []
+        for c in conds:
+            if c.get("status") == "True":
+                v += f"  {CHECK} {c.get('type')}\n"
+            else:
+                reason = c.get("reason", "")
+                suffix = f" ({reason})" if reason else ""
+                v += f"  {XMARK} {c.get('type')}{dim(suffix)}\n"
+        return v
+
+
+class UploadModel:
+    """Upload progress panel (reference: upload.go)."""
+
+    def __init__(self, obj_name: str = ""):
+        self.obj_name = obj_name
+        self.messages: List[str] = []
+        self.state = IN_PROGRESS
+        self.spinner = Spinner()
+
+    def update(self, msg) -> None:
+        if isinstance(msg, m.Tick):
+            self.spinner.tick()
+        elif isinstance(msg, m.UploadProgress):
+            self.messages.append(msg.message)
+        elif isinstance(msg, (m.TarballUploaded, m.Applied)):
+            self.state = COMPLETED
+
+    def view(self) -> str:
+        if not self.messages and self.state == COMPLETED:
+            return ""
+        if self.state == COMPLETED:
+            return f"{CHECK} {self.messages[-1]}\n"
+        if not self.messages:
+            return f"{self.spinner.view()} preparing upload…\n"
+        return f"{self.spinner.view()} {self.messages[-1]}\n"
+
+
+class PodsModel:
+    """Pods grouped by role with streaming log viewports (reference:
+    pods.go). Starting a log stream per newly-ready container is the
+    caller's job: update() returns commands for new streams."""
+
+    ROLES = ("build", "run")
+
+    def __init__(self, client=None, height: int = 8, width: int = 100):
+        self.client = client
+        self.height, self.width = height, width
+        # role -> name -> {"pod": dict, "viewport": Viewport, "streaming": bool}
+        self.pods: Dict[str, Dict[str, dict]] = {r: {} for r in self.ROLES}
+        self.watching = IN_PROGRESS
+
+    def _entry(self, role: str, name: str) -> dict:
+        return self.pods.setdefault(role, {}).setdefault(
+            name, {"pod": None, "viewport": Viewport(self.height, self.width),
+                   "streaming": False, "deleted": False})
+
+    def update(self, msg) -> Optional[list]:
+        if isinstance(msg, m.PodWatch):
+            pod = msg.pod
+            role = ko.labels(pod).get("role", "run")
+            entry = self._entry(role, ko.name(pod))
+            entry["pod"] = pod
+            if msg.event == "DELETED":
+                entry["deleted"] = True
+                return None
+            entry["deleted"] = False
+            phase = ko.deep_get(pod, "status", "phase", default="")
+            if (not entry["streaming"] and self.client is not None
+                    and phase in ("Running", "Succeeded", "Failed")
+                    and hasattr(self.client, "pod_logs")):
+                entry["streaming"] = True
+                return [stream_logs_cmd(self.client, pod)]
+        elif isinstance(msg, m.PodLogs):
+            entry = self._entry(msg.role, msg.name)
+            entry["viewport"].append(msg.text)
+        elif isinstance(msg, m.WindowSize):
+            self.width = msg.width  # future viewports too, not just live ones
+            for role in self.pods:
+                for entry in self.pods[role].values():
+                    entry["viewport"].width = msg.width
+        return None
+
+    def view(self) -> str:
+        any_pods = any(self.pods[r] for r in self.pods)
+        if not any_pods:
+            return ""
+        v = bold("Pods:") + "\n"
+        for role in self.ROLES:
+            entries = [e for e in self.pods.get(role, {}).values()
+                       if not e["deleted"] and e["pod"] is not None]
+            entries.sort(key=lambda e: ko.deep_get(
+                e["pod"], "metadata", "creationTimestamp", default=""))
+            for e in entries:
+                pod = e["pod"]
+                phase = ko.deep_get(pod, "status", "phase", default="Pending")
+                v += f"> {role.title()} {dim(ko.name(pod))} ({phase})\n"
+                if phase != "Succeeded" and e["viewport"].lines:
+                    v += e["viewport"].view() + "\n"
+        return v
+
+
+class ManifestsModel:
+    """Manifest discovery panel (reference: manifests.go)."""
+
+    def __init__(self, path: str = "."):
+        self.path = path
+        self.objects: List[dict] = []
+        self.loaded = False
+
+    def update(self, msg) -> None:
+        if isinstance(msg, m.ManifestsLoaded):
+            self.objects = msg.objects
+            self.loaded = True
+
+    def view(self) -> str:
+        if not self.loaded:
+            return dim(f"Reading manifests from {self.path}…") + "\n"
+        if not self.objects:
+            return error_style(f"No manifests found in {self.path}") + "\n"
+        names = ", ".join(f"{o['kind']}/{ko.name(o)}" for o in self.objects)
+        return dim(f"Manifests: {names}") + "\n"

@@ -1,0 +1,117 @@
+"""TUI building blocks: ANSI styles, spinner, log viewport, table.
+
+Reference analog: internal/tui/styles.go (lipgloss styles, check/x marks) and
+the bubbles spinner/viewport components used by pods.go and readiness.go.
+Implemented on raw ANSI escapes; every widget renders to a plain string so
+views compose by concatenation and tests can assert on stripped text.
+"""
+
+from __future__ import annotations
+
+import re
+import textwrap
+from typing import List
+
+ANSI_RE = re.compile(r"\x1b\[[0-9;]*m")
+
+
+def strip_ansi(s: str) -> str:
+    return ANSI_RE.sub("", s)
+
+
+def _sgr(code: str):
+    def style(s: str) -> str:
+        return f"\x1b[{code}m{s}\x1b[0m"
+    return style
+
+
+bold = _sgr("1")
+dim = _sgr("2")
+red = _sgr("31")
+green = _sgr("32")
+yellow = _sgr("33")
+blue = _sgr("34")
+magenta = _sgr("35")
+cyan = _sgr("36")
+
+CHECK = green("✔")
+XMARK = red("✗")
+
+
+def help_style(s: str) -> str:
+    return dim(s)
+
+
+def error_style(s: str) -> str:
+    return red(bold(s))
+
+
+class Spinner:
+    """Dot spinner advanced by Tick messages (bubbles spinner analog)."""
+
+    FRAMES = "⣾⣽⣻⢿⡿⣟⣯⣷"
+
+    def __init__(self):
+        self._i = 0
+
+    def tick(self) -> None:
+        self._i = (self._i + 1) % len(self.FRAMES)
+
+    def view(self) -> str:
+        return cyan(self.FRAMES[self._i])
+
+
+class Viewport:
+    """Fixed-height tail viewport over appended text (bubbles viewport
+    analog as pods.go uses it: always scrolled to bottom, line-rewrites
+    normalized to appends)."""
+
+    def __init__(self, height: int = 8, width: int = 80,
+                 max_lines: int = 2000):
+        self.height = height
+        self.width = width
+        self.max_lines = max_lines
+        self._lines: List[str] = []
+
+    def append(self, text: str) -> None:
+        # \r-rewrites (progress bars) become plain lines, like the
+        # reference's ReplaceAll("\r", "\n") normalization.
+        text = text.replace("\r\n", "\n").replace("\r", "\n")
+        for line in text.split("\n"):
+            if line:
+                self._lines.append(line)
+        if len(self._lines) > self.max_lines:
+            del self._lines[:len(self._lines) - self.max_lines]
+
+    @property
+    def lines(self) -> List[str]:
+        return list(self._lines)
+
+    def view(self) -> str:
+        wrapped: List[str] = []
+        for line in self._lines[-self.height * 2:]:
+            wrapped.extend(
+                textwrap.wrap(line, max(self.width - 2, 10),
+                              drop_whitespace=False) or [""])
+        tail = wrapped[-self.height:]
+        return "\n".join("  " + dim("│ ") + ln for ln in tail)
+
+
+def render_table(header: List[str], rows: List[List[str]],
+                 width: int = 0) -> str:
+    """Aligned text table; cells may carry ANSI (widths use stripped text)."""
+    all_rows = [header] + rows
+    n = len(header)
+    widths = [max(len(strip_ansi(str(r[i]))) for r in all_rows)
+              for i in range(n)]
+
+    def fmt(row, style=lambda s: s):
+        cells = []
+        for c, w in zip(row, widths):
+            pad = w - len(strip_ansi(str(c)))
+            cells.append(style(str(c)) + " " * pad)
+        return "  ".join(cells).rstrip()
+
+    out = [fmt(header, bold)]
+    out += [fmt(r) for r in rows]
+    return "\n".join(out)

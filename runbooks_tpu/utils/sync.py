@@ -7,6 +7,11 @@ containertools/cmd/nbwatch): copy the nbwatch binary into the pod
 kubectl-cp it back (delete locally on REMOVE/RENAME). The watcher itself is
 the native C++ tool in native/nbwatch (built per-arch; inside the workload
 images it ships at /usr/local/bin/nbwatch).
+
+``sync_loop`` is the blocking engine with a progress callback (the TUI runs
+it on a command thread and renders the events — reference:
+notebookSyncFilesCmd); ``start_sync`` is the plain-CLI wrapper that runs it
+on a daemon thread printing progress lines.
 """
 
 from __future__ import annotations
@@ -15,12 +20,17 @@ import json
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 NBWATCH_LOCAL = os.path.join(os.path.dirname(__file__), "..", "..",
                              "native", "nbwatch", "nbwatch")
 NBWATCH_REMOTE = "/tmp/nbwatch"
 CONTENT_ROOT = "/content"
+
+# on_event(file, complete, error, removed=False): file started syncing
+# (complete=False), finished (complete=True; removed=True when the event was
+# a local deletion rather than a pull), or failed (error set).
+OnEvent = Callable[..., None]
 
 
 def _kubectl(*args: str, **kwargs):
@@ -40,50 +50,64 @@ def copy_to_pod(pod: str, namespace: str, local_path: str,
     _kubectl("cp", "-n", namespace, local_path, f"{pod}:{remote_path}")
 
 
+def sync_loop(pod: str, namespace: str, local_dir: str,
+              nbwatch_path: Optional[str] = None,
+              on_event: OnEvent = lambda f, c, e, r=False: None) -> None:
+    """Blocking sync loop: exec nbwatch in the pod, mirror each event."""
+    binary = nbwatch_path or os.path.abspath(NBWATCH_LOCAL)
+    try:
+        if os.path.exists(binary):
+            copy_to_pod(pod, namespace, binary, NBWATCH_REMOTE)
+            _kubectl("exec", "-n", namespace, pod, "--", "chmod", "+x",
+                     NBWATCH_REMOTE)
+            watcher_cmd = NBWATCH_REMOTE
+        else:
+            # Image ships its own (workload images install it).
+            watcher_cmd = "nbwatch"
+        proc = subprocess.Popen(
+            ["kubectl", "exec", "-n", namespace, pod, "--",
+             watcher_cmd, CONTENT_ROOT],
+            stdout=subprocess.PIPE, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        on_event("", True, e, False)
+        return
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            event = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        rel = os.path.relpath(event["path"], CONTENT_ROOT)
+        local_path = os.path.join(local_dir, rel)
+        removed = event["op"] in ("REMOVE", "RENAME")
+        on_event(rel, False, None, removed)
+        try:
+            if removed:
+                if os.path.exists(local_path):
+                    os.remove(local_path)
+            else:
+                copy_from_pod(pod, namespace, event["path"], local_path)
+            on_event(rel, True, None, removed)
+        except subprocess.CalledProcessError as e:
+            on_event(rel, True, e, removed)
+
+
 def start_sync(pod: str, namespace: str, local_dir: str,
                nbwatch_path: Optional[str] = None) -> threading.Thread:
-    """Start the sync loop in a daemon thread; returns the thread."""
+    """Plain-CLI mode: run the sync loop in a daemon thread, print events."""
 
-    def run():
-        binary = nbwatch_path or os.path.abspath(NBWATCH_LOCAL)
-        try:
-            if os.path.exists(binary):
-                copy_to_pod(pod, namespace, binary, NBWATCH_REMOTE)
-                _kubectl("exec", "-n", namespace, pod, "--", "chmod", "+x",
-                         NBWATCH_REMOTE)
-                watcher_cmd = NBWATCH_REMOTE
-            else:
-                # Image ships its own (workload images install it).
-                watcher_cmd = "nbwatch"
-            proc = subprocess.Popen(
-                ["kubectl", "exec", "-n", namespace, pod, "--",
-                 watcher_cmd, CONTENT_ROOT],
-                stdout=subprocess.PIPE, text=True)
-        except (subprocess.CalledProcessError, FileNotFoundError) as e:
-            print(f"sync: disabled ({e})")
-            return
-        assert proc.stdout is not None
-        for line in proc.stdout:
-            line = line.strip()
-            if not line.startswith("{"):
-                continue
-            try:
-                event = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            rel = os.path.relpath(event["path"], CONTENT_ROOT)
-            local_path = os.path.join(local_dir, rel)
-            try:
-                if event["op"] in ("REMOVE", "RENAME"):
-                    if os.path.exists(local_path):
-                        os.remove(local_path)
-                        print(f"sync: removed {rel}")
-                else:
-                    copy_from_pod(pod, namespace, event["path"], local_path)
-                    print(f"sync: pulled {rel}")
-            except subprocess.CalledProcessError:
-                print(f"sync: failed to mirror {rel}")
+    def on_event(rel, complete, err, removed=False):
+        if err is not None:
+            print(f"sync: failed to mirror {rel or '(setup)'}: {err}")
+        elif complete and rel:
+            print(f"sync: {'removed' if removed else 'pulled'} {rel}")
 
-    thread = threading.Thread(target=run, daemon=True)
+    thread = threading.Thread(
+        target=sync_loop, args=(pod, namespace, local_dir, nbwatch_path,
+                                on_event),
+        daemon=True)
     thread.start()
     return thread

@@ -1,0 +1,323 @@
+"""Headless tests for the TUI update loops.
+
+The reference's bubbletea models are pure state machines testable without a
+terminal; ours keep that property. Tests drive update(msg) directly, run
+returned commands synchronously with a collecting `send`, and assert on
+ANSI-stripped view() text. (Reference test strategy analog: SURVEY.md §4 —
+the TUI layer has no terminal in CI either.)
+"""
+
+from __future__ import annotations
+
+import queue
+
+from runbooks_tpu.api.types import API_VERSION
+from runbooks_tpu.k8s.fake import FakeCluster
+from runbooks_tpu.tui import messages as m
+from runbooks_tpu.tui.core import decode_keys
+from runbooks_tpu.tui.flows import (
+    ApplyFlow,
+    DeleteFlow,
+    GetFlow,
+    NotebookFlow,
+    RunFlow,
+    ServeFlow,
+)
+from runbooks_tpu.tui.submodels import (
+    PodsModel,
+    ReadinessModel,
+    UploadModel,
+)
+from runbooks_tpu.tui.widgets import Viewport, render_table, strip_ansi
+
+
+def run_cmds(model, cmds, collected=None, depth=0):
+    """Run commands synchronously, feeding resulting messages back into the
+    model (a deterministic stand-in for Program's thread pump)."""
+    collected = collected if collected is not None else []
+    assert depth < 12, "runaway command loop"
+    for cmd in cmds or []:
+        if getattr(cmd, "long_running", False):
+            continue  # watches/polls: Program runs these on threads
+        inbox: "queue.Queue[object]" = queue.Queue()
+        result = cmd(inbox.put)
+        msgs = []
+        while not inbox.empty():
+            msgs.append(inbox.get())
+        if result is not None:
+            msgs.append(result)
+        for msg in msgs:
+            collected.append(msg)
+            follow = model.update(msg)
+            run_cmds(model, follow, collected, depth + 1)
+    return collected
+
+
+def feed(model, msg):
+    """update() one message, then run any returned commands synchronously."""
+    cmds = model.update(msg)
+    return run_cmds(model, cmds)
+
+
+def notebook_obj(name="nb1", ready=False, conditions=None):
+    obj = {"apiVersion": API_VERSION, "kind": "Notebook",
+           "metadata": {"name": name, "namespace": "default"},
+           "spec": {"image": "img"}}
+    if conditions is not None or ready:
+        obj["status"] = {"ready": ready, "conditions": conditions or []}
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# Widgets
+# ---------------------------------------------------------------------------
+
+def test_viewport_tails_and_normalizes_cr():
+    vp = Viewport(height=3, width=40)
+    vp.append("progress 10%\rprogress 50%\rdone")
+    for i in range(10):
+        vp.append(f"line {i}")
+    text = strip_ansi(vp.view())
+    assert "line 9" in text and "line 7" in text
+    assert "line 2" not in text  # beyond tail window
+    assert len(text.split("\n")) == 3
+
+
+def test_render_table_aligns_with_ansi():
+    from runbooks_tpu.tui.widgets import green
+    out = strip_ansi(render_table(
+        ["NAME", "READY"], [["models/m1", green("yes")], ["servers/s1", "no"]]))
+    lines = out.split("\n")
+    assert lines[0].index("READY") == lines[1].index("yes")
+    assert lines[0].index("READY") == lines[2].index("no")
+
+
+def test_decode_keys():
+    assert decode_keys(b"q") == ["q"]
+    assert decode_keys(b"\x03") == ["ctrl+c"]
+    assert decode_keys(b"\x1b[A") == ["up"]
+    assert decode_keys(b"\x1b") == ["esc"]
+    assert decode_keys(b"\r") == ["enter"]
+    assert decode_keys(b"ab") == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# Sub-models
+# ---------------------------------------------------------------------------
+
+def test_readiness_checklist_renders_conditions():
+    rm = ReadinessModel(notebook_obj(conditions=[
+        {"type": "Built", "status": "True"},
+        {"type": "Complete", "status": "False", "reason": "JobNotComplete"},
+    ]))
+    view = strip_ansi(rm.view())
+    assert "✔ Built" in view
+    assert "✗ Complete (JobNotComplete)" in view
+
+    rm.update(m.ObjectReady(notebook_obj(ready=True)))
+    assert "Ready" in strip_ansi(rm.view())
+
+
+def test_upload_model_shows_latest_progress():
+    um = UploadModel("nb1")
+    um.update(m.UploadProgress("nb1", "packed 123 bytes"))
+    assert "packed 123 bytes" in strip_ansi(um.view())
+    um.update(m.TarballUploaded(notebook_obj()))
+    assert "✔" in strip_ansi(um.view())
+
+
+def test_pods_model_streams_logs_for_running_pods():
+    fake = FakeCluster()
+    fake.set_pod_logs("default", "nb1-notebook", "hello\nworld")
+    pm = PodsModel(fake)
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "nb1-notebook", "namespace": "default",
+                        "labels": {"notebook": "nb1", "role": "run"}},
+           "status": {"phase": "Running"}}
+    cmds = pm.update(m.PodWatch("ADDED", pod))
+    assert cmds, "a running pod should start a log stream"
+    msgs = run_cmds(pm, cmds)
+    assert any(isinstance(x, m.PodLogs) for x in msgs)
+    view = strip_ansi(pm.view())
+    assert "Run nb1-notebook (Running)" in view
+    assert "world" in view
+
+    # Same pod again: no duplicate stream.
+    assert not pm.update(m.PodWatch("MODIFIED", pod))
+    pm.update(m.PodWatch("DELETED", pod))
+    assert "nb1-notebook" not in strip_ansi(pm.view())
+
+
+# ---------------------------------------------------------------------------
+# Flows
+# ---------------------------------------------------------------------------
+
+def manifests_dir(tmp_path, docs):
+    import yaml
+    f = tmp_path / "app.yaml"
+    f.write_text(yaml.safe_dump_all(docs))
+    return str(tmp_path)
+
+
+def test_notebook_flow_applies_and_reaches_ready(tmp_path):
+    fake = FakeCluster()
+    flow = NotebookFlow(fake, manifests_dir(tmp_path, [notebook_obj()]),
+                        "default", sync=False,
+                        pf_runner=lambda argv: 0)
+
+    # Manifest discovery -> apply (no upload spec) — run only the manifest
+    # load; wait_ready would block until the controller acts.
+    msgs = run_cmds(flow, flow.init()[:1])
+    assert any(isinstance(x, m.ManifestsLoaded) for x in msgs)
+    assert fake.get(API_VERSION, "Notebook", "default", "nb1") is not None
+    assert flow.notebook is not None
+
+    # Controller-side readiness, delivered as messages.
+    flow.update(m.ObjectUpdate(notebook_obj(conditions=[
+        {"type": "Built", "status": "False", "reason": "Building"}])))
+    assert "✗ Built" in strip_ansi(flow.view())
+
+    cmds = flow.update(m.ObjectReady(notebook_obj(ready=True)))
+    # Port-forward command fires (runner stub returns success).
+    msgs = run_cmds(flow, cmds)
+    assert any(isinstance(x, m.PortForwardReady) for x in msgs)
+    assert "http://localhost:8888" in strip_ansi(flow.view())
+
+
+def test_notebook_flow_quit_confirm_suspend(tmp_path):
+    fake = FakeCluster()
+    fake.create(notebook_obj())
+    flow = NotebookFlow(fake, manifests_dir(tmp_path, [notebook_obj()]),
+                        "default", sync=False)
+    flow.notebook = notebook_obj()
+
+    assert flow.update(m.Key("q")) == []
+    assert flow.quitting
+    assert 'suspend' in strip_ansi(flow.view())
+
+    # esc cancels.
+    flow.update(m.Key("esc"))
+    assert not flow.quitting
+
+    # q then s suspends via SSA patch and quits with a goodbye.
+    flow.update(m.Key("q"))
+    msgs = feed(flow, m.Key("s"))
+    assert any(isinstance(x, m.Quit) for x in msgs)
+    assert flow.goodbye == "Notebook suspended."
+    nb = fake.get(API_VERSION, "Notebook", "default", "nb1")
+    assert nb["spec"]["suspend"] is True
+
+
+def test_notebook_flow_delete_key(tmp_path):
+    fake = FakeCluster()
+    fake.create(notebook_obj())
+    flow = NotebookFlow(fake, manifests_dir(tmp_path, [notebook_obj()]),
+                        "default", sync=False)
+    flow.notebook = notebook_obj()
+    flow.update(m.Key("q"))
+    msgs = feed(flow, m.Key("d"))
+    assert any(isinstance(x, m.Quit) for x in msgs)
+    assert fake.get(API_VERSION, "Notebook", "default", "nb1") is None
+
+
+def test_run_flow_increments_name_and_quits_on_ready(tmp_path):
+    fake = FakeCluster()
+    fake.create(notebook_obj("job"))       # existing base name
+    fake.create(notebook_obj("job-3"))     # existing increment
+    flow = RunFlow(fake, manifests_dir(tmp_path, [notebook_obj("job")]),
+                   "default", increment=True)
+    run_cmds(flow, flow.init()[:1])
+    assert flow.obj["metadata"]["name"] == "job-4"
+    assert fake.get(API_VERSION, "Notebook", "default", "job-4") is not None
+
+    msgs = feed(flow, m.ObjectReady(notebook_obj("job-4", ready=True)))
+    assert any(isinstance(x, m.Quit) for x in msgs)
+    assert "ready" in flow.goodbye
+
+
+def test_serve_flow_port_forwards_when_ready():
+    fake = FakeCluster()
+    server = {"apiVersion": API_VERSION, "kind": "Server",
+              "metadata": {"name": "srv", "namespace": "default"},
+              "spec": {"model": {"name": "m1"}}}
+    fake.create(server)
+    flow = ServeFlow(fake, "srv", "default", local_port=8001,
+                     pf_runner=lambda argv: 0)
+    run_cmds(flow, flow.init()[:1])
+    assert flow.server is not None
+
+    server["status"] = {"ready": True}
+    msgs = feed(flow, m.ObjectReady(server))
+    assert any(isinstance(x, m.PortForwardReady) for x in msgs)
+    assert "http://localhost:8001" in strip_ansi(flow.view())
+
+
+def test_serve_flow_missing_server_errors():
+    flow = ServeFlow(FakeCluster(), "absent", "default")
+    msgs = run_cmds(flow, flow.init())
+    assert any(isinstance(x, m.Error) for x in msgs)
+    assert flow.final_error is not None
+    assert "not found" in str(flow.final_error)
+
+
+def test_apply_flow_applies_all_and_quits(tmp_path):
+    fake = FakeCluster()
+    docs = [notebook_obj("a"),
+            {"apiVersion": API_VERSION, "kind": "Model",
+             "metadata": {"name": "mm", "namespace": "default"},
+             "spec": {"image": "img"}}]
+    flow = ApplyFlow(fake, manifests_dir(tmp_path, docs), "default",
+                     wait=False)
+    msgs = run_cmds(flow, flow.init())
+    assert any(isinstance(x, m.Quit) for x in msgs)
+    assert fake.get(API_VERSION, "Notebook", "default", "a") is not None
+    assert fake.get(API_VERSION, "Model", "default", "mm") is not None
+    assert "applied" in flow.goodbye
+
+
+def test_delete_flow_marks_absent_and_deleted():
+    fake = FakeCluster()
+    fake.create(notebook_obj("nb1"))
+    flow = DeleteFlow(fake, [("Notebook", "nb1"), ("Model", "ghost")],
+                      "default")
+    msgs = run_cmds(flow, flow.init())
+    assert any(isinstance(x, m.Quit) for x in msgs)
+    view = strip_ansi(flow.view())
+    assert "✔ notebooks/nb1" in view
+    assert "absent models/ghost" in view
+    assert fake.get(API_VERSION, "Notebook", "default", "nb1") is None
+
+
+def test_get_flow_tracks_watch_events():
+    flow = GetFlow(FakeCluster(), "default")
+    flow.update(m.WatchEvent("ADDED", notebook_obj("nb1")))
+    flow.update(m.WatchEvent("ADDED", notebook_obj("nb2", ready=True)))
+    view = strip_ansi(flow.view())
+    assert "notebooks/nb1" in view and "notebooks/nb2" in view
+    assert "Total: 2" in view
+
+    flow.update(m.WatchEvent("DELETED", notebook_obj("nb1")))
+    view = strip_ansi(flow.view())
+    assert "notebooks/nb1" not in view
+    assert "Total: 1" in view
+
+
+def test_get_flow_name_filter():
+    flow = GetFlow(FakeCluster(), "default", kind_filter="Notebook",
+                   name_filter="nb2")
+    flow.update(m.WatchEvent("ADDED", notebook_obj("nb1")))
+    flow.update(m.WatchEvent("ADDED", notebook_obj("nb2")))
+    view = strip_ansi(flow.view())
+    assert "nb1" not in view and "nb2" in view
+
+
+def test_get_flow_quits_on_q():
+    flow = GetFlow(FakeCluster(), "default")
+    msgs = feed(flow, m.Key("q"))
+    assert any(isinstance(x, m.Quit) for x in msgs)
+
+
+def test_flow_error_message_renders():
+    flow = GetFlow(FakeCluster(), "default")
+    feed(flow, m.Error(RuntimeError("boom")))
+    assert "Error: boom" in strip_ansi(flow.view())
