@@ -1,0 +1,10 @@
+"""Hello-world for the notebook example: confirms JAX sees the accelerator
+and the container contract mounts exist."""
+
+import os
+
+import jax
+
+print("devices:", jax.devices())
+for p in ("/content/data", "/content/model", "/content/artifacts"):
+    print(p, "->", "mounted" if os.path.isdir(p) else "absent")

@@ -60,12 +60,20 @@ def load_tokenizer(name_or_path: Optional[str] = None):
     return ByteTokenizer()
 
 
-def read_documents(path: str, text_key: str = "text") -> Iterator[str]:
+def read_documents(path: str, text_key: str = "text",
+                   prompt_template: Optional[str] = None) -> Iterator[str]:
     """Yield documents from a file or directory: .jsonl ({text_key: ...} per
-    line), .txt (one doc per file), or a directory of either."""
+    line), .txt (one doc per file), or a directory of either.
+
+    prompt_template renders each jsonl record through str.format (e.g.
+    "## Instruction\\n{prompt}\\n## Response:\\n{completion}") — the analog
+    of the reference trainer images' prompt_template param
+    (reference: examples/falcon-7b-instruct/finetuned-model-custom-prompt
+    .yaml); records missing a referenced field are skipped."""
     if os.path.isdir(path):
         for name in sorted(os.listdir(path)):
-            yield from read_documents(os.path.join(path, name), text_key)
+            yield from read_documents(os.path.join(path, name), text_key,
+                                      prompt_template)
         return
     if path.endswith((".jsonl", ".json")):
         with open(path) as f:
@@ -74,7 +82,13 @@ def read_documents(path: str, text_key: str = "text") -> Iterator[str]:
                 if not line:
                     continue
                 obj = json.loads(line)
-                text = obj.get(text_key)
+                if prompt_template is not None and isinstance(obj, dict):
+                    try:
+                        text = prompt_template.format(**obj)
+                    except (KeyError, IndexError):
+                        continue
+                else:
+                    text = obj.get(text_key)
                 if text:
                     yield text
     elif path.endswith(".txt"):
@@ -165,13 +179,15 @@ def dataset(
     tokenizer=None,
     epochs: Optional[int] = 1,
     text_key: str = "text",
+    prompt_template: Optional[str] = None,
 ) -> Iterator[Batch]:
     """End-to-end: files -> packed, batched numpy batches. epochs=None loops
     forever."""
     tokenizer = tokenizer or ByteTokenizer()
     epoch = 0
     while epochs is None or epoch < epochs:
-        docs = (tokenizer.encode(t) for t in read_documents(path, text_key))
+        docs = (tokenizer.encode(t)
+                for t in read_documents(path, text_key, prompt_template))
         yield from batch_rows(pack_documents(docs, seq_len), batch_size)
         epoch += 1
 

@@ -1,0 +1,99 @@
+"""Dataset-loader workload: fetch raw data into the artifact store.
+
+The TPU-native replacement for the reference's external dataset images
+(reference: examples/datasets/k8s-instructions.yaml pulls
+substratusai/images//dataset-loader-http, squad.yaml a prebuilt
+dataset-squad image). Runs under the container contract as the Dataset
+reconciler's ``{name}-data-loader`` Job:
+
+  params.json: {"urls": "https://... , https://...",   # comma or list
+                "paths": ["/some/local.jsonl"],        # pre-mounted files
+                "text_key": "text"}                    # jsonl field to keep
+
+Each source is copied to /content/artifacts (the Dataset's bucket prefix,
+mounted RW). Downstream Model jobs mount that prefix RO at /content/data and
+feed it to train.data's tokenize->pack pipeline. A dataset.json manifest
+records what was loaded (row/byte counts) — the analog of the reference
+images' load logs, but machine-readable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import urllib.parse
+import urllib.request
+
+from runbooks_tpu.utils import contract
+
+
+def _sources(params_cfg: dict) -> list:
+    urls = params_cfg.get("urls", [])
+    if isinstance(urls, str):
+        urls = [u.strip() for u in urls.split(",") if u.strip()]
+    return list(urls) + list(params_cfg.get("paths", []))
+
+
+def _fetch(src: str, dest_dir: str) -> str:
+    """Download/copy one source into dest_dir; returns the local filename."""
+    name = os.path.basename(urllib.parse.urlparse(src).path) or "data"
+    dest = os.path.join(dest_dir, name)
+    if src.startswith(("http://", "https://", "file://")):
+        with urllib.request.urlopen(src, timeout=120) as resp, \
+                open(dest, "wb") as out:
+            shutil.copyfileobj(resp, out)
+    else:
+        shutil.copy(src, dest)
+    return dest
+
+
+def _count_rows(path: str, text_key: str) -> int:
+    if not path.endswith((".jsonl", ".json", ".txt")):
+        return 0
+    rows = 0
+    with open(path, "rb") as f:
+        for line in f:
+            if not path.endswith(".jsonl"):
+                rows += 1
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(doc, dict) and text_key in doc:
+                rows += 1
+    return rows
+
+
+def main() -> int:
+    params_cfg = contract.load_params()
+    artifacts = params_cfg.get("artifacts_dir") or contract.artifacts_dir()
+    os.makedirs(artifacts, exist_ok=True)
+    text_key = params_cfg.get("text_key", "text")
+
+    sources = _sources(params_cfg)
+    if not sources:
+        raise SystemExit("dataset_loader: no 'urls' or 'paths' in params")
+
+    files = []
+    for src in sources:
+        dest = _fetch(src, artifacts)
+        files.append({
+            "source": src,
+            "file": os.path.basename(dest),
+            "bytes": os.path.getsize(dest),
+            "rows": _count_rows(dest, text_key),
+        })
+
+    manifest = {"files": files, "text_key": text_key,
+                "total_bytes": sum(f["bytes"] for f in files),
+                "total_rows": sum(f["rows"] for f in files)}
+    with open(os.path.join(artifacts, "dataset.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    print(json.dumps({"done": True, **manifest}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

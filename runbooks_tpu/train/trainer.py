@@ -48,6 +48,10 @@ class TrainJobConfig:
     steps: int = 100
     data_path: Optional[str] = None       # default: contract data dir
     tokenizer: Optional[str] = None
+    text_key: str = "text"                # jsonl field holding the document
+    # str.format template over jsonl record fields (reference analog: the
+    # trainer images' prompt_template param).
+    prompt_template: Optional[str] = None
     seed: int = 0
 
     checkpoint_every: int = 50
@@ -100,7 +104,9 @@ def _batches(job: TrainJobConfig, model_cfg: ModelConfig) -> Iterator[dict]:
             f"tokenizer vocab {vocab} exceeds model vocab "
             f"{model_cfg.vocab_size}")
         return data_mod.dataset(path, job.seq_len, job.batch_size,
-                                tokenizer=tok, epochs=None)
+                                tokenizer=tok, epochs=None,
+                                text_key=job.text_key,
+                                prompt_template=job.prompt_template)
     return data_mod.synthetic_batches(model_cfg.vocab_size, job.seq_len,
                                       job.batch_size, job.seed)
 

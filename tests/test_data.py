@@ -1,0 +1,60 @@
+"""Input-pipeline extras: prompt templating and the dataset-loader workload.
+
+Reference analogs: the trainer images' prompt_template param
+(reference: examples/falcon-7b-instruct/finetuned-model-custom-prompt.yaml)
+and the dataset-loader-http image (reference: examples/datasets/
+k8s-instructions.yaml)."""
+
+import json
+
+from runbooks_tpu.train import data as data_mod
+from runbooks_tpu.train import dataset_loader
+
+
+def test_read_documents_prompt_template(tmp_path):
+    f = tmp_path / "d.jsonl"
+    rows = [{"prompt": "make a pod", "completion": "kind: Pod"},
+            {"prompt": "no completion field"},
+            {"text": "plain"}]
+    f.write_text("\n".join(json.dumps(r) for r in rows))
+
+    tmpl = "## Instruction\n{prompt}\n## Response:\n{completion}"
+    docs = list(data_mod.read_documents(str(f), prompt_template=tmpl))
+    # Rows missing a referenced field are skipped, not crashed on.
+    assert docs == ["## Instruction\nmake a pod\n## Response:\nkind: Pod"]
+
+    # Without a template, text_key selects the field.
+    assert list(data_mod.read_documents(str(f))) == ["plain"]
+    assert list(data_mod.read_documents(str(f), text_key="prompt")) == \
+        ["make a pod", "no completion field"]
+
+
+def test_dataset_loader_writes_manifest(tmp_path, monkeypatch):
+    src = tmp_path / "src.jsonl"
+    src.write_text('{"text": "a"}\n{"text": "b"}\nnot json\n')
+    out = tmp_path / "artifacts"
+
+    monkeypatch.setattr(dataset_loader.contract, "load_params",
+                        lambda: {"paths": [str(src)],
+                                 "artifacts_dir": str(out)})
+    assert dataset_loader.main() == 0
+
+    copied = out / "src.jsonl"
+    assert copied.read_text() == src.read_text()
+    manifest = json.loads((out / "dataset.json").read_text())
+    assert manifest["total_rows"] == 2
+    assert manifest["files"][0]["file"] == "src.jsonl"
+    assert manifest["total_bytes"] == src.stat().st_size
+
+
+def test_dataset_loader_file_url(tmp_path, monkeypatch):
+    src = tmp_path / "u.txt"
+    src.write_text("hello\nworld\n")
+    out = tmp_path / "artifacts"
+    monkeypatch.setattr(dataset_loader.contract, "load_params",
+                        lambda: {"urls": f"file://{src}",
+                                 "artifacts_dir": str(out)})
+    assert dataset_loader.main() == 0
+    assert (out / "u.txt").read_text() == "hello\nworld\n"
+    manifest = json.loads((out / "dataset.json").read_text())
+    assert manifest["total_rows"] == 2  # .txt rows = line count
