@@ -1,10 +1,12 @@
 """OpenAI-compatible HTTP serving on the container contract.
 
-Serves /v1/completions on port 8080 with readiness at GET / — the exact
-surface the reference's Server resource expects of a serving container
-(reference: internal/controller/server_controller.go readiness probe GET /
-port 8080 "http-serve"; test/system.sh curls /v1/completions). The engine
-behind it does slot-based continuous batching (serve/engine.py).
+Serves /v1/completions and /v1/chat/completions on port 8080 with readiness
+at GET / — the exact surface the reference's Server resource expects of a
+serving container (reference: internal/controller/server_controller.go
+readiness probe GET / port 8080 "http-serve"; test/system.sh curls
+/v1/completions; the reference's documented basaran server streams, and so
+does this one: `"stream": true` returns SSE chunks). The engine behind it
+does slot-based continuous batching (serve/engine.py).
 
 Run: ``python -m runbooks_tpu.serve.api`` (reads /content/params.json:
 model, checkpoint, max_slots, port, tokenizer) or programmatically via
@@ -27,6 +29,17 @@ from runbooks_tpu.models.config import ModelConfig, get_config
 from runbooks_tpu.serve.engine import InferenceEngine, Request
 from runbooks_tpu.train.data import load_tokenizer
 from runbooks_tpu.utils import contract
+
+
+def _eos_id(tok) -> Optional[int]:
+    """Tokenizer EOS id across both tokenizer flavors (ByteTokenizer's
+    eos_id, HF's eos_token_id). Explicit None checks: an EOS id of 0 is
+    legitimate and must not read as missing."""
+    for attr in ("eos_id", "eos_token_id"):
+        val = getattr(tok, attr, None)
+        if val is not None:
+            return int(val)
+    return None
 
 
 def load_model(params: dict) -> Tuple[ModelConfig, Any]:
@@ -183,17 +196,18 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         except json.JSONDecodeError:
             return web.json_response(
                 {"error": {"message": "invalid JSON body"}}, status=400)
-        return await _complete(request.app, body)
+        return await _complete(request.app, body, http_request=request)
 
-    async def _complete(app_, body) -> web.Response:
+    def _parse_requests(app_, body):
+        """Shared validation: body -> list[Request] or an error Response."""
         prompt = body.get("prompt")
         if prompt is None:
-            return web.json_response(
+            return None, web.json_response(
                 {"error": {"message": "missing required field: prompt"}},
                 status=400)
         prompts = prompt if isinstance(prompt, list) else [prompt]
         if not prompts or not all(isinstance(p, str) for p in prompts):
-            return web.json_response(
+            return None, web.json_response(
                 {"error": {"message": "prompt must be a string or a "
                                       "non-empty list of strings"}},
                 status=400)
@@ -203,16 +217,16 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             top_p = float(body.get("top_p", 1.0))
             top_k = int(body.get("top_k", 0))
         except (TypeError, ValueError):
-            return web.json_response(
+            return None, web.json_response(
                 {"error": {"message": "malformed sampling parameters"}},
                 status=400)
         if max_tokens < 1:
-            return web.json_response(
-                {"error": {"message": "max_tokens must be >= 1"}}, status=400)
+            return None, web.json_response(
+                {"error": {"message": "max_tokens must be >= 1"}},
+                status=400)
 
         tok = app_["tokenizer"]
-        eos = getattr(tok, "eos_id", None) or getattr(tok, "eos_token_id",
-                                                      None)
+        eos = _eos_id(tok)
         reqs = []
         for p in prompts:
             ids = tok.encode(p, add_bos=True, add_eos=False) \
@@ -221,6 +235,135 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 prompt_tokens=list(ids), max_tokens=max_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos))
+        return reqs, None
+
+    async def _stream(app_, body, reqs, http_request,
+                      chat: bool = False) -> web.StreamResponse:
+        """SSE streaming (OpenAI `stream: true`): one chunk per text delta,
+        then a finish chunk per choice, then `data: [DONE]`. The engine's
+        on_token hook fires on its worker thread; call_soon_threadsafe
+        bridges into this handler's event loop. Deltas come from an
+        incremental decoder: only tokens since the last committed delta are
+        re-decoded (a token is not a fixed string — multibyte chars resolve
+        only once their continuation lands, signalled by a trailing
+        U+FFFD), so per-request cost is O(tokens), not O(tokens^2)."""
+        tok = app_["tokenizer"]
+        eos = _eos_id(tok)
+        loop = asyncio.get_running_loop()
+        events: asyncio.Queue = asyncio.Queue()
+        for i, r in enumerate(reqs):
+            r.on_token = (lambda t, i=i: loop.call_soon_threadsafe(
+                events.put_nowait, i))
+        worker = app_["worker"]
+        app_["requests_total"] += len(reqs)
+        try:
+            futs = [asyncio.wrap_future(worker.submit(r)) for r in reqs]
+        except ValueError as exc:
+            app_["requests_failed_total"] += len(reqs)
+            return web.json_response(
+                {"error": {"message": str(exc)}}, status=400)
+        for i, f in enumerate(futs):
+            f.add_done_callback(
+                lambda fut, i=i: events.put_nowait(("done", i, fut)))
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        })
+        await resp.prepare(http_request)
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        created = int(time.time())
+        role_sent = [False] * len(reqs)
+
+        def chunk(i, text=None, finish=None):
+            if chat:
+                delta = {} if text is None else {"content": text}
+                if not role_sent[i]:
+                    role_sent[i] = True
+                    delta = {"role": "assistant", **delta}
+                choice = {"index": i, "delta": delta,
+                          "finish_reason": finish}
+            else:
+                choice = {"index": i, "text": text or "",
+                          "finish_reason": finish}
+            payload = {"id": rid, "created": created,
+                       "model": app_["model_name"],
+                       "object": ("chat.completion.chunk" if chat
+                                  else "text_completion"),
+                       "choices": [choice]}
+            return f"data: {json.dumps(payload)}\n\n".encode()
+
+        start = [0] * len(reqs)  # first output token not yet committed
+
+        def next_delta(i, flush=False):
+            """Decode tokens committed since last delta; hold back a
+            trailing incomplete multibyte sequence unless flushing."""
+            ids = reqs[i].output_tokens
+            if eos is not None and ids and ids[-1] == eos:
+                ids = ids[:-1]
+            pending = ids[start[i]:]
+            if not pending:
+                return None
+            text = tok.decode(pending)
+            if not flush and text.endswith("�"):
+                return None  # wait for the rest of the character
+            start[i] = len(ids)
+            return text or None
+
+        remaining = len(reqs)
+        try:
+            while remaining:
+                ev = await asyncio.wait_for(events.get(), timeout=600)
+                if isinstance(ev, tuple):  # ("done", i, future)
+                    _, i, fut = ev
+                    remaining -= 1
+                    exc = fut.exception()
+                    if exc is not None:
+                        # Mid-stream failure: the HTTP status is already
+                        # 200, so signal in-band (OpenAI's error-event
+                        # shape) instead of a silent fake "stop".
+                        app_["requests_failed_total"] += 1
+                        await resp.write(
+                            b'data: ' + json.dumps({"error": {
+                                "message": str(exc), "index": i,
+                            }}).encode() + b"\n\n")
+                        continue
+                    delta = next_delta(i, flush=True)
+                    if delta is not None:
+                        await resp.write(chunk(i, text=delta))
+                    app_["tokens_total"] += len(reqs[i].output_tokens)
+                    await resp.write(chunk(
+                        i, finish=reqs[i].finish_reason or "stop"))
+                    continue
+                delta = next_delta(ev)
+                if delta is not None:
+                    await resp.write(chunk(ev, text=delta))
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (asyncio.TimeoutError, ConnectionResetError):
+            # Client went away (or generation stalled): retrieve the
+            # remaining futures' exceptions so asyncio doesn't log
+            # "exception was never retrieved", and don't touch the dead
+            # transport again.
+            app_["requests_failed_total"] += remaining
+            for f in futs:
+                if f.done():
+                    f.exception()
+                else:
+                    f.add_done_callback(lambda fut: fut.exception())
+        return resp
+
+    async def _complete(app_, body, http_request=None) -> web.Response:
+        reqs, err = _parse_requests(app_, body)
+        if err is not None:
+            return err
+        if body.get("stream") and http_request is not None:
+            return await _stream(app_, body, reqs, http_request,
+                                 chat=bool(body.pop("_chat", False)))
+        tok = app_["tokenizer"]
+        eos = _eos_id(tok)
         worker = app_["worker"]
         app_["requests_total"] += len(reqs)
         try:
@@ -301,7 +444,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                      for m in messages]
             prompt = "\n".join(parts) + "\nassistant:"
         body["prompt"] = prompt
-        resp = await _complete(request.app, body)
+        body["_chat"] = True
+        resp = await _complete(request.app, body, http_request=request)
+        if not isinstance(resp, web.Response):
+            return resp  # SSE stream already written
         if resp.status != 200:
             return resp
         payload = json.loads(resp.body)

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +47,10 @@ class Request:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
     finish_reason: str = ""
+    # Streaming hook: called (from the engine/worker thread) after each
+    # generated token lands in output_tokens. Keep it cheap and non-blocking
+    # — it runs inside the decode loop (SSE uses call_soon_threadsafe).
+    on_token: Optional[Callable[[int], None]] = None
     _slot: int = -1
 
 
@@ -269,6 +273,8 @@ class InferenceEngine:
         req = self.slot_req[slot]
         assert req is not None
         req.output_tokens.append(tok)
+        if req.on_token is not None:
+            req.on_token(tok)
         hit_eos = req.eos_id is not None and tok == req.eos_id
         out_len = len(req.output_tokens)
         # lengths[slot] counts tokens written to the cache; the next decode

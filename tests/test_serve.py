@@ -239,3 +239,68 @@ def test_http_api_end_to_end():
             assert "context window" in body["error"]["message"]
 
     asyncio.run(drive())
+
+
+def test_http_streaming_sse():
+    """`stream: true` returns SSE chunks whose concatenated deltas equal the
+    non-streamed completion, ending with a finish chunk and [DONE] (the
+    reference's documented server, basaran, streams the same protocol)."""
+    import json as _json
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from runbooks_tpu.serve.api import create_server
+
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    app = create_server(cfg, params, max_slots=2)
+
+    def parse_sse(raw: str):
+        events = []
+        for line in raw.split("\n"):
+            if line.startswith("data: "):
+                payload = line[len("data: "):]
+                events.append(payload if payload == "[DONE]"
+                              else _json.loads(payload))
+        return events
+
+    async def drive():
+        async with TestClient(TestServer(app)) as client:
+            # Reference answer without streaming (greedy => deterministic).
+            r = await client.post("/v1/completions", json={
+                "prompt": "hello", "max_tokens": 5, "temperature": 0.0})
+            expect = (await r.json())["choices"][0]["text"]
+
+            r = await client.post("/v1/completions", json={
+                "prompt": "hello", "max_tokens": 5, "temperature": 0.0,
+                "stream": True})
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            events = parse_sse(await r.text())
+            assert events[-1] == "[DONE]"
+            chunks = events[:-1]
+            assert all(e["object"] == "text_completion" for e in chunks)
+            text = "".join(c["choices"][0]["text"] for c in chunks)
+            assert text == expect
+            finishes = [c["choices"][0]["finish_reason"] for c in chunks]
+            assert finishes[-1] in ("length", "stop")
+            # more than one delta chunk => actually incremental
+            assert len(chunks) >= 2
+
+            # chat streaming: delta format, role announced once
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0.0, "stream": True})
+            assert r.status == 200
+            events = parse_sse(await r.text())
+            assert events[-1] == "[DONE]"
+            chunks = events[:-1]
+            assert all(e["object"] == "chat.completion.chunk"
+                       for e in chunks)
+            deltas = [c["choices"][0]["delta"] for c in chunks]
+            assert any(d.get("content") for d in deltas)
+            # the assistant role is announced exactly once, in the first delta
+            assert deltas[0].get("role") == "assistant"
+            assert sum(1 for d in deltas if "role" in d) == 1
+
+    asyncio.run(drive())
