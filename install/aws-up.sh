@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# EKS install: cluster (OIDC/IRSA) + S3 bucket + ECR repo + workload role +
+# Karpenter-style autoscaling + operator with the AWS SCI.
+# Reference analog: install/scripts/aws-up.sh + install/kubernetes/aws/*.tpl
+# (eksctl + Karpenter + nvidia device plugin). Re-designed, not copied: the
+# accelerator story differs — TPUs are GCP-only, so on AWS this framework
+# runs the operator/CPU workloads (model import, dataset loading, CPU
+# serving smoke) and cross-cloud artifact plumbing; accelerator jobs target
+# a GKE TPU cluster. GPU node support can be layered with a Karpenter
+# NodePool if needed.
+set -euo pipefail
+
+: "${AWS_ACCOUNT_ID:?set AWS_ACCOUNT_ID}"
+REGION="${REGION:-us-west-2}"
+CLUSTER="${CLUSTER:-runbooks-tpu}"
+BUCKET="${BUCKET:-${AWS_ACCOUNT_ID}-${CLUSTER}-artifacts}"
+REPO="${REPO:-${CLUSTER}}"
+ROLE="${ROLE:-${CLUSTER}-workload}"
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+# Artifact storage + image registry.
+aws s3 mb "s3://${BUCKET}" --region "$REGION" >/dev/null || true
+aws ecr create-repository --repository-name "$REPO" \
+  --region "$REGION" >/dev/null || true
+
+# Cluster with OIDC enabled (IRSA is the identity mechanism the AWS SCI
+# binds through — sci/aws.py edits this role's trust policy per KSA).
+export CLUSTER REGION AWS_ACCOUNT_ID
+envsubst <"${SCRIPT_DIR}/aws/eks-cluster.yaml.tpl" >/tmp/eks-cluster.yaml
+eksctl create cluster -f /tmp/eks-cluster.yaml ||
+  eksctl upgrade cluster -f /tmp/eks-cluster.yaml
+
+# The workload IAM role: S3 access to the artifact bucket; trust policy
+# statements are appended at runtime by the SCI BindIdentity RPC.
+OIDC_URL=$(aws eks describe-cluster --name "$CLUSTER" --region "$REGION" \
+  --query "cluster.identity.oidc.issuer" --output text)
+cat >/tmp/trust.json <<EOF
+{
+  "Version": "2012-10-17",
+  "Statement": []
+}
+EOF
+aws iam create-role --role-name "$ROLE" \
+  --assume-role-policy-document file:///tmp/trust.json >/dev/null || true
+aws iam put-role-policy --role-name "$ROLE" \
+  --policy-name artifacts-rw --policy-document "{
+    \"Version\": \"2012-10-17\",
+    \"Statement\": [{
+      \"Effect\": \"Allow\",
+      \"Action\": [\"s3:GetObject\", \"s3:PutObject\", \"s3:ListBucket\"],
+      \"Resource\": [\"arn:aws:s3:::${BUCKET}\",
+                     \"arn:aws:s3:::${BUCKET}/*\"]
+    }]
+  }"
+
+# CPU autoscaling pool for build/import/serve jobs (Karpenter NodePool
+# analog of the reference's provisioner template).
+envsubst <"${SCRIPT_DIR}/aws/nodepool.yaml.tpl" | kubectl apply -f - || true
+
+# Operator + AWS SCI.
+kubectl apply -f "${SCRIPT_DIR}/../config/crd/"
+kubectl apply -f "${SCRIPT_DIR}/../config/rbac/role.yaml"
+kubectl apply -f "${SCRIPT_DIR}/../config/manager/manager.yaml"
+kubectl apply -f "${SCRIPT_DIR}/../config/sci/deployment.yaml"
+kubectl create configmap system -n runbooks-tpu \
+  --from-literal CLOUD=aws \
+  --from-literal CLUSTER_NAME="$CLUSTER" \
+  --from-literal ARTIFACT_BUCKET_URL="s3://${BUCKET}" \
+  --from-literal REGISTRY_URL="${AWS_ACCOUNT_ID}.dkr.ecr.${REGION}.amazonaws.com/${REPO}" \
+  --from-literal PRINCIPAL="$ROLE" \
+  --from-literal AWS_ACCOUNT_ID="$AWS_ACCOUNT_ID" \
+  --from-literal AWS_REGION="$REGION" \
+  --from-literal OIDC_PROVIDER_URL="$OIDC_URL" \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+echo "done — try: rbt apply -f examples/facebook-opt-125m --wait"

@@ -1,0 +1,17 @@
+# eksctl cluster template (reference analog: install/kubernetes/aws/
+# eks-cluster.yaml.tpl). envsubst vars: CLUSTER, REGION, AWS_ACCOUNT_ID.
+apiVersion: eksctl.io/v1alpha5
+kind: ClusterConfig
+metadata:
+  name: ${CLUSTER}
+  region: ${REGION}
+  version: "1.29"
+iam:
+  withOIDC: true   # IRSA: the AWS SCI binds KSAs via this provider
+managedNodeGroups:
+  - name: system
+    instanceType: m6i.large
+    desiredCapacity: 2
+    minSize: 2
+    maxSize: 4
+    labels: {role: system}

@@ -1,0 +1,27 @@
+# Karpenter NodePool for burst CPU capacity (builds, model import, CPU
+# serving). Reference analog: install/kubernetes/aws/
+# karpenter-provisioner.yaml.tpl (which provisioned GPU nodes; TPU
+# accelerator jobs run on GKE — see install/gcp-up.sh).
+apiVersion: karpenter.sh/v1beta1
+kind: NodePool
+metadata:
+  name: runbooks-tpu-cpu
+spec:
+  template:
+    spec:
+      requirements:
+        - key: kubernetes.io/arch
+          operator: In
+          values: ["amd64"]
+        - key: karpenter.sh/capacity-type
+          operator: In
+          values: ["spot", "on-demand"]
+        - key: karpenter.k8s.aws/instance-category
+          operator: In
+          values: ["c", "m", "r"]
+      nodeClassRef:
+        name: default
+  limits:
+    cpu: 256
+  disruption:
+    consolidationPolicy: WhenUnderutilized

@@ -23,6 +23,10 @@ REASON_BUILT = "ImageBuilt"
 REASON_JOB_RUNNING = "JobRunning"
 REASON_JOB_COMPLETE = "JobComplete"
 REASON_JOB_FAILED = "JobFailed"
+# A multi-host slice Job failed (e.g. one host died) and was recreated to
+# resume from the last checkpoint (SURVEY §7 hard part #1). Net-new vs the
+# reference, which treats any Job failure as terminal.
+REASON_JOB_RESTARTED = "JobRestarted"
 REASON_DEPLOYMENT_READY = "DeploymentReady"
 REASON_DEPLOYMENT_NOT_READY = "DeploymentNotReady"
 REASON_POD_READY = "PodReady"

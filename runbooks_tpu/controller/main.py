@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 
@@ -76,6 +77,24 @@ def make_manager(ctx):
     ])
 
 
+def run_with_leader_election(mgr, elector, stop, poll_s: float = 0.5):
+    """Run the manager only while holding the lease: acquire -> reconcile;
+    lose -> stop reconciling (watch loops wound down); reacquire -> run
+    again. Standbys idle in the wait loop. (Reference analog: controller-
+    runtime's leader-election gate around manager start.)"""
+    while not stop.is_set():
+        if elector.is_leader.wait(timeout=poll_s):
+            leader_stop = threading.Event()
+
+            def watch_leadership():
+                while elector.is_leader.is_set() and not stop.is_set():
+                    time.sleep(poll_s / 5)
+                leader_stop.set()
+
+            threading.Thread(target=watch_leadership, daemon=True).start()
+            mgr.run(leader_stop)
+
+
 class _Health(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — http.server API
         if self.path in ("/healthz", "/readyz"):
@@ -123,19 +142,7 @@ def main() -> int:
             mgr.run(stop)
         else:
             # Only the leaseholder reconciles; standbys idle until acquired.
-            while not stop.is_set():
-                if elector.is_leader.wait(timeout=1.0):
-                    leader_stop = threading.Event()
-
-                    def watch_leadership():
-                        while elector.is_leader.is_set() and \
-                                not stop.is_set():
-                            threading.Event().wait(0.5)
-                        leader_stop.set()
-
-                    threading.Thread(target=watch_leadership,
-                                     daemon=True).start()
-                    mgr.run(leader_stop)
+            run_with_leader_election(mgr, elector, stop)
     except KeyboardInterrupt:
         stop.set()
         if elector is not None:

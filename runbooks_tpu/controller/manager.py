@@ -50,16 +50,33 @@ class Manager:
 
     # -- test driver (envtest analog) ----------------------------------
 
-    def reconcile_until_stable(self, max_rounds: int = 25) -> int:
+    def reconcile_until_stable(self, max_rounds: int = 25,
+                               raise_errors: bool = True) -> int:
         """Reconcile every object of every registered kind repeatedly until
-        a full round produces no object changes. Returns rounds used."""
+        a full round produces no object changes. Returns rounds used.
+
+        raise_errors=True (tests) propagates reconciler exceptions;
+        the deployment resync path passes False so one bad object (e.g. a
+        transient 409) cannot terminate the whole manager loop."""
         for round_no in range(1, max_rounds + 1):
             changed = False
             for kind, recs in self.reconcilers.items():
                 for obj in self.ctx.client.list(API_VERSION, kind):
                     before = (ko.deep_get(obj, "metadata", "resourceVersion"),)
                     for rec in recs:
-                        rec.reconcile(self.ctx, obj)
+                        try:
+                            rec.reconcile(self.ctx, obj)
+                        except Exception:  # noqa: BLE001
+                            if raise_errors:
+                                raise
+                            import traceback
+
+                            from runbooks_tpu.controller.metrics import \
+                                REGISTRY
+
+                            REGISTRY.inc("controller_reconcile_errors_total",
+                                         kind=kind)
+                            traceback.print_exc()
                     after_obj = self.ctx.client.get(
                         API_VERSION, kind, ko.namespace(obj), ko.name(obj))
                     if after_obj is None:
@@ -105,7 +122,8 @@ class Manager:
                         traceback.print_exc()
             if time.monotonic() - last_resync > resync_seconds:
                 last_resync = time.monotonic()
-                self.reconcile_until_stable(max_rounds=3)
+                self.reconcile_until_stable(max_rounds=3,
+                                            raise_errors=False)
                 worked = True
             if not worked:
                 time.sleep(0.02)

@@ -34,6 +34,9 @@ from runbooks_tpu.controller.manager import Ctx, Result
 from runbooks_tpu.k8s import objects as ko
 
 
+RESTARTS_ANNOTATION = "runbooks-tpu.dev/slice-restarts"
+
+
 class ModelReconciler:
     kind = "Model"
 
@@ -94,6 +97,47 @@ class ModelReconciler:
         complete = all(c for c, _ in statuses)
         failed = any(f for _, f in statuses)
         if failed:
+            # Slice-restart-with-resume (SURVEY §7 hard part #1): a TPU
+            # slice Job fails whole (backoffLimit 0, one dead host fails the
+            # slice). Instead of treating that as terminal like the
+            # reference does, recreate the Job — the trainer resumes from
+            # the last orbax checkpoint in the artifact bucket — up to
+            # resources.tpu.maxRestarts (default 3) attempts.
+            if any(ko.deep_get(j, "metadata", "deletionTimestamp")
+                   for j in existing_jobs if j is not None):
+                # Restart already in flight: Job deletion is asynchronous
+                # (finalizers, pod GC). Don't count another attempt while
+                # the old Job is still terminating.
+                return Result(requeue_after=1.0)
+            limit = int((model.tpu or {}).get("maxRestarts", 3)) \
+                if model.tpu else 0
+            restarts = int(ko.annotations(model.obj).get(
+                RESTARTS_ANNOTATION, "0"))
+            if restarts < limit:
+                for j, name in zip(existing_jobs, job_names):
+                    if j is not None:
+                        ctx.client.delete("batch/v1", "Job",
+                                          model.namespace, name)
+                # Dedicated field manager: owns only the restart counter.
+                ctx.client.apply({
+                    "apiVersion": model.obj["apiVersion"], "kind": "Model",
+                    "metadata": {"name": model.name,
+                                 "namespace": model.namespace,
+                                 "annotations": {
+                                     RESTARTS_ANNOTATION: str(restarts + 1),
+                                 }}}, "model-controller-restart")
+                # Re-read before the status write: the apply above bumped
+                # the resourceVersion, and a stale PUT /status 409s on a
+                # real apiserver.
+                fresh = ctx.client.get(model.obj["apiVersion"], "Model",
+                                       model.namespace, model.name)
+                model = Model(fresh if fresh is not None else model.obj)
+                model.set_condition(
+                    cond.COMPLETE, False, cond.REASON_JOB_RESTARTED,
+                    f"slice restart {restarts + 1}/{limit}; resuming from "
+                    "last checkpoint")
+                ctx.client.update_status(model.obj)
+                return Result(requeue_after=1.0)
             model.set_condition(cond.COMPLETE, False, cond.REASON_JOB_FAILED,
                                 f"job {job_name} failed")
             model.set_ready(False)
@@ -109,6 +153,15 @@ class ModelReconciler:
             changed = True
         if changed:
             ctx.client.update_status(model.obj)
+        if RESTARTS_ANNOTATION in ko.annotations(model.obj):
+            # Success clears the restart budget: a future retrain starts
+            # with a full maxRestarts, not the leftovers of this run.
+            ctx.client.apply({
+                "apiVersion": model.obj["apiVersion"], "kind": "Model",
+                "metadata": {"name": model.name,
+                             "namespace": model.namespace,
+                             "annotations": {RESTARTS_ANNOTATION: None}},
+            }, "model-controller-restart")
         return Result()
 
     # ------------------------------------------------------------------

@@ -206,6 +206,14 @@ class FakeCluster:
                     sub.put("ADDED", obj)
         return sub
 
+    def unwatch(self, sub: Subscription) -> None:
+        """Deregister a watch (long-lived servers like the HTTP fake must
+        drop per-connection subscriptions or _notify fans out to an
+        ever-growing dead list)."""
+        with self._lock:
+            self._subs = [(av, k, s) for (av, k, s) in self._subs
+                          if s is not sub]
+
     def _notify(self, event: str, obj: Obj) -> None:
         for av, k, sub in self._subs:
             if (av is None or av == ko.api_version(obj)) and \

@@ -1,0 +1,205 @@
+"""HTTP apiserver fake: FakeCluster semantics behind real Kubernetes REST
+paths.
+
+Purpose: wire-level testing of k8s.client.K8sClient (the stdlib REST
+client) without a cluster — the reference runs a real envtest apiserver for
+this (reference: internal/controller/main_test.go:46-191); this shim covers
+the protocol layer (URL shapes, SSA PATCH content type + fieldManager,
+status subresource, 404/409 mapping, chunked watch streams) while
+delegating object semantics to the in-memory FakeCluster.
+
+Every request is recorded (method, path, query, content type) so tests can
+assert the client put the right bytes on the wire, not just that state
+changed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.k8s.fake import AlreadyExists, Conflict, FakeCluster, NotFound
+
+# Reverse of client.PLURALS, plus lowercase kind fallback.
+from runbooks_tpu.k8s.client import PLURALS
+
+SINGULARS = {v: k for k, v in PLURALS.items()}
+
+
+def _parse_path(path: str) -> Optional[dict]:
+    """/api/v1/... or /apis/{group}/{version}/... ->
+    {api_version, kind, namespace, name, subresource}."""
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return None
+    if parts[0] == "api" and len(parts) >= 2:
+        api_version = parts[1]
+        rest = parts[2:]
+    elif parts[0] == "apis" and len(parts) >= 3:
+        api_version = f"{parts[1]}/{parts[2]}"
+        rest = parts[3:]
+    else:
+        return None
+    namespace = None
+    if len(rest) >= 2 and rest[0] == "namespaces":
+        namespace = rest[1]
+        rest = rest[2:]
+    if not rest:
+        return None
+    plural = rest[0]
+    kind = SINGULARS.get(plural, plural[:-1].capitalize())
+    name = rest[1] if len(rest) >= 2 else None
+    subresource = rest[2] if len(rest) >= 3 else None
+    return {"api_version": api_version, "kind": kind,
+            "namespace": namespace, "name": name,
+            "subresource": subresource}
+
+
+class FakeApiServer:
+    """Threaded HTTP server over a FakeCluster. Use as a context manager."""
+
+    def __init__(self, cluster: Optional[FakeCluster] = None):
+        self.cluster = cluster or FakeCluster()
+        self.requests: List[Tuple[str, str, str, str]] = []  # m, p, q, ct
+        shim = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _record(self):
+                parsed = urllib.parse.urlparse(self.path)
+                shim.requests.append(
+                    (self.command, parsed.path, parsed.query,
+                     self.headers.get("Content-Type", "")))
+                return parsed
+
+            def _send_json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _route(self):
+                parsed = self._record()
+                ref = _parse_path(parsed.path)
+                if ref is None:
+                    return self._send_json(404, {"message": "bad path"})
+                query = urllib.parse.parse_qs(parsed.query)
+                try:
+                    self._dispatch(ref, query)
+                except NotFound as e:
+                    self._send_json(404, {"reason": "NotFound",
+                                          "message": str(e)})
+                except AlreadyExists as e:
+                    self._send_json(409, {"reason": "AlreadyExists",
+                                          "message": f"AlreadyExists: {e}"})
+                except Conflict as e:
+                    self._send_json(409, {"reason": "Conflict",
+                                          "message": str(e)})
+
+            def _dispatch(self, ref, query):
+                c = shim.cluster
+                av, kind = ref["api_version"], ref["kind"]
+                ns, name = ref["namespace"], ref["name"]
+                if self.command == "GET" and query.get("watch"):
+                    return self._watch(av, kind, ns)
+                if self.command == "GET" and name:
+                    obj = c.get(av, kind, ns, name)
+                    if obj is None:
+                        raise NotFound(f"{kind} {ns}/{name}")
+                    return self._send_json(200, obj)
+                if self.command == "GET":
+                    sel = None
+                    if query.get("labelSelector"):
+                        sel = dict(kv.split("=", 1) for kv in
+                                   query["labelSelector"][0].split(","))
+                    items = c.list(av, kind, namespace=ns,
+                                   label_selector=sel)
+                    return self._send_json(200, {"kind": f"{kind}List",
+                                                 "items": items})
+                if self.command == "POST":
+                    return self._send_json(201, c.create(self._body()))
+                if self.command == "PUT" and ref["subresource"] == "status":
+                    return self._send_json(200, c.update_status(self._body()))
+                if self.command == "PUT":
+                    return self._send_json(200, c.update(self._body()))
+                if self.command == "PATCH":
+                    fm = (query.get("fieldManager") or [""])[0]
+                    ct = self.headers.get("Content-Type", "")
+                    if ct != "application/apply-patch+yaml":
+                        return self._send_json(
+                            415, {"message": f"unsupported patch type {ct}"})
+                    if not fm:
+                        return self._send_json(
+                            422, {"message": "fieldManager is required for "
+                                             "server-side apply"})
+                    return self._send_json(200, c.apply(self._body(), fm))
+                if self.command == "DELETE":
+                    if not c.delete(av, kind, ns, name):
+                        raise NotFound(f"{kind} {ns}/{name}")
+                    return self._send_json(200, {"status": "Success"})
+                self._send_json(405, {"message": self.command})
+
+            def _watch(self, av, kind, ns):
+                sub = shim.cluster.watch(av, kind)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def send_chunk(data: bytes):
+                    self.wfile.write(f"{len(data):x}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    idle = 0
+                    while idle < 100:  # ~10s then close (client reconnects)
+                        got = sub.poll(timeout=0.1)
+                        if got is None:
+                            idle += 1
+                            continue
+                        idle = 0
+                        event, obj = got
+                        if ns and ko.namespace(obj) != ns:
+                            continue
+                        line = json.dumps(
+                            {"type": event, "object": obj}) + "\n"
+                        send_chunk(line.encode())
+                    send_chunk(b"")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    shim.cluster.unwatch(sub)
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _route
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> "FakeApiServer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()

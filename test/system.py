@@ -148,6 +148,24 @@ def main() -> int:
     assert body["usage"]["completion_tokens"] >= 1, body
     print("ok: /v1/completions answered", body["usage"])
 
+    # 5. Streamed completion over the same HTTP wire (SSE, stream: true).
+    req = urllib.request.Request(
+        f"http://localhost:{serve_port}/v1/completions",
+        data=json.dumps({"prompt": "Hello", "max_tokens": 8,
+                         "temperature": 0.0, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        raw = resp.read().decode()
+    events = [ln[len("data: "):] for ln in raw.split("\n")
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]", events[-1:]
+    streamed = "".join(
+        json.loads(e)["choices"][0]["text"] for e in events[:-1])
+    assert streamed == body["choices"][0]["text"], (
+        streamed, body["choices"][0]["text"])
+    print("ok: /v1/completions streamed", len(events) - 1, "chunks")
+
     stop.set()
     grpc_server.stop(grace=0)
     print("SYSTEM TEST PASSED")

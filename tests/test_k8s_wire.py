@@ -1,0 +1,138 @@
+"""Wire-level K8sClient tests against the HTTP apiserver fake.
+
+Round-1 gap (VERDICT item 5): the real REST client had only ever run
+against the in-memory FakeCluster object interface, so its HTTP layer (URL
+construction, SSA patch content type + field manager, status subresource,
+watch stream parsing, 404/409 handling) was untested — and the fake had
+already masked one SSA bug. These tests put real bytes on a real socket.
+Reference analog: internal/controller/main_test.go's envtest apiserver.
+"""
+
+import ssl
+import time
+
+import pytest
+
+from runbooks_tpu.api.types import API_VERSION
+from runbooks_tpu.k8s.client import AlreadyExists, Conflict, K8sClient, KubeConfig
+from runbooks_tpu.k8s.httpfake import FakeApiServer
+
+
+@pytest.fixture()
+def server():
+    with FakeApiServer() as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    cfg = KubeConfig(server.url, ssl.create_default_context(), {})
+    return K8sClient(cfg)
+
+
+def model(name="m1", ns="default", **spec):
+    return {"apiVersion": API_VERSION, "kind": "Model",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"image": "img", **spec}}
+
+
+def test_create_get_update_delete_roundtrip(client, server):
+    created = client.create(model())
+    assert created["metadata"]["uid"]
+
+    got = client.get(API_VERSION, "Model", "default", "m1")
+    assert got["spec"]["image"] == "img"
+
+    got["spec"]["image"] = "img2"
+    updated = client.update(got)
+    assert updated["spec"]["image"] == "img2"
+    assert updated["metadata"]["generation"] == 2
+
+    assert client.delete(API_VERSION, "Model", "default", "m1") is True
+    assert client.delete(API_VERSION, "Model", "default", "m1") is False
+    assert client.get(API_VERSION, "Model", "default", "m1") is None
+
+    # URL shape: custom resources under /apis/{group}/{version}/namespaces/.
+    paths = [p for (_, p, _, _) in server.requests]
+    assert f"/apis/{API_VERSION}/namespaces/default/models/m1" in paths
+    assert f"/apis/{API_VERSION}/namespaces/default/models" in paths
+
+
+def test_core_v1_url_shape(client, server):
+    client.create({"apiVersion": "v1", "kind": "ConfigMap",
+                   "metadata": {"name": "cm", "namespace": "ns1"},
+                   "data": {"k": "v"}})
+    assert ("POST", "/api/v1/namespaces/ns1/configmaps", "",
+            "application/json") in server.requests
+
+
+def test_ssa_apply_field_manager_on_wire(client, server):
+    client.apply(model(), "mgr-a")
+    method, path, query, ctype = server.requests[-1]
+    assert method == "PATCH"
+    assert path.endswith("/models/m1")
+    assert "fieldManager=mgr-a" in query and "force=true" in query
+    assert ctype == "application/apply-patch+yaml"
+
+    # Partial apply from a second manager merges rather than replaces.
+    client.apply({"apiVersion": API_VERSION, "kind": "Model",
+                  "metadata": {"name": "m1", "namespace": "default",
+                               "annotations": {"a": "b"}}}, "mgr-b")
+    got = client.get(API_VERSION, "Model", "default", "m1")
+    assert got["spec"]["image"] == "img"
+    assert got["metadata"]["annotations"]["a"] == "b"
+
+
+def test_status_subresource_on_wire(client, server):
+    client.create(model())
+    obj = client.get(API_VERSION, "Model", "default", "m1")
+    obj["status"] = {"ready": True}
+    client.update_status(obj)
+    method, path, _, _ = server.requests[-1]
+    assert (method, path.rsplit("/", 1)[-1]) == ("PUT", "status")
+    assert client.get(API_VERSION, "Model", "default",
+                      "m1")["status"]["ready"] is True
+
+
+def test_conflict_and_already_exists_mapping(client, server):
+    client.create(model())
+    with pytest.raises(AlreadyExists):
+        client.create(model())
+
+    stale = client.get(API_VERSION, "Model", "default", "m1")
+    client.update(stale)  # bumps resourceVersion server-side
+    stale["spec"]["image"] = "race"
+    with pytest.raises(Conflict):
+        client.update(stale)
+
+
+def test_list_with_label_selector(client, server):
+    obj = model("lab1")
+    obj["metadata"]["labels"] = {"team": "a"}
+    client.create(obj)
+    client.create(model("lab2"))
+    got = client.list(API_VERSION, "Model", namespace="default",
+                      label_selector={"team": "a"})
+    assert [o["metadata"]["name"] for o in got] == ["lab1"]
+    # items get apiVersion/kind backfilled (lists omit them)
+    assert got[0]["kind"] == "Model"
+
+
+def test_watch_streams_events(client, server):
+    sub = client.watch(API_VERSION, "Model", namespace="default")
+    time.sleep(0.3)  # let the stream connect
+    client.create(model("w1"))
+    event = sub.poll(timeout=5.0)
+    assert event is not None
+    etype, obj = event
+    assert etype == "ADDED"
+    assert obj["metadata"]["name"] == "w1"
+
+    client.delete(API_VERSION, "Model", "default", "w1")
+    for _ in range(10):
+        event = sub.poll(timeout=5.0)
+        assert event is not None
+        if event[0] == "DELETED":
+            break
+    else:
+        raise AssertionError("no DELETED event")
