@@ -43,6 +43,14 @@ class ModelConfig:
     activation: str = "silu"          # "silu" | "gelu" | "relu"
     mlp_bias: bool = False
 
+    # Mixture of Experts (models/moe.py). 0 experts = dense MLP. With
+    # experts, the FFN becomes top-k-routed gated experts whose leading dim
+    # shards over the "expert" mesh axis (expert parallelism).
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01        # load-balance loss weight
+
     # Attention
     attn_bias: bool = False
     qk_norm: bool = False
@@ -78,6 +86,11 @@ class ModelConfig:
     # Training-time behavior
     remat_policy: str = "nothing_saveable"  # see train/step.py
 
+    # Pipeline parallelism: microbatches per step when the mesh has a
+    # "stage" axis > 1 (parallel/pipeline.py). 0 = one microbatch per
+    # stage; more microbatches shrink the (S-1)/(S+M-1) bubble.
+    pipeline_microbatches: int = 0
+
     @property
     def activation_dtype(self):
         return jnp.dtype(self.dtype)
@@ -110,6 +123,10 @@ class ModelConfig:
         mlp_mats += self.intermediate_size * h
         if self.mlp_bias:
             mlp_mats += (2 if self.gated_mlp else 1) * self.intermediate_size + h
+        if self.moe_num_experts:
+            # E expert copies of the (gated) FFN + the router matrix.
+            mlp_mats = self.moe_num_experts * mlp_mats \
+                + h * self.moe_num_experts
         norms_per_layer = h if (self.parallel_block and self.shared_layer_norm) else 2 * h
         if self.norm_type == "layernorm":
             norms_per_layer *= 2  # scale + bias
@@ -128,6 +145,9 @@ class ModelConfig:
         attn_scores = 2 * 2 * s * self.q_dim  # QK^T and PV, per token
         mlp = 2 * ((2 if self.gated_mlp else 1) * h * self.intermediate_size
                    + self.intermediate_size * h)
+        if self.moe_num_experts:
+            # top-k active experts per token + the router matmul.
+            mlp = mlp * self.moe_top_k + 2 * h * self.moe_num_experts
         per_layer = attn_proj + attn_scores + mlp
         head = 2 * h * self.vocab_size
         return float(self.num_layers * per_layer + head)
@@ -186,6 +206,12 @@ CONFIGS = {
     # OPT (reference: examples/facebook-opt-125m — the CPU smoke model)
     "opt-125m": _opt("opt-125m"),
     "opt-1.3b": _opt("opt-1.3b", h=2048, i=8192, l=24, q=32),
+    # Mixtral-style MoE (net-new: the reference has no MoE; expert
+    # parallelism over the "expert" mesh axis — models/moe.py)
+    "mixtral-8x7b": dataclasses.replace(
+        _llama("mixtral-8x7b", v=32000, h=4096, i=14336, l=32, q=32, kv=8,
+               d=128, s=32768, theta=1e6),
+        moe_num_experts=8, moe_top_k=2),
     # Debug/bench sizes
     "debug": _llama("debug", v=512, h=128, i=384, l=2, q=4, kv=2, d=32, s=256),
     "bench-1b": _llama("bench-1b", h=2048, i=5632, l=22, q=16, kv=16, d=128, s=2048),

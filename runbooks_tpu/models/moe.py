@@ -1,0 +1,115 @@
+"""Mixture-of-Experts layer with expert parallelism (GShard/Switch-style).
+
+TPU-first design: routing is expressed as dense one-hot dispatch/combine
+einsums over a static expert *capacity* — no dynamic shapes, no scatter —
+so XLA tiles everything onto the MXU, and sharding the expert leading dim
+over the "expert" mesh axis turns the dispatch/combine contractions into
+cross-device token exchange (all-to-all family) handled by GSPMD.
+(Reference has no MoE — SURVEY §2a — this is net-new capability; pattern
+references: the GShard/Switch dispatch formulation in PAPERS.md.)
+
+Tokens beyond an expert's capacity are dropped (contribute zero); size
+capacity_factor so drops are rare. The router aux (load-balance) loss is
+returned to the caller and added to the training loss with moe_aux_coef.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from runbooks_tpu.parallel.sharding import with_logical_constraint
+
+
+def moe_capacity(cfg, n_tokens: int) -> int:
+    """Static per-expert token capacity."""
+    cap = math.ceil(cfg.moe_top_k * n_tokens / cfg.moe_num_experts
+                    * cfg.moe_capacity_factor)
+    return max(int(cap), 1)
+
+
+def _dispatch_combine(cfg, probs: jax.Array, n_tokens: int):
+    """Top-k routing -> (dispatch [T,E,C] bool-ish, combine [T,E,C] float,
+    aux load-balance scalar). Choice-major priority: every token's first
+    choice is placed before any token's second choice (Switch convention),
+    so capacity pressure drops low-weight assignments first."""
+    E = cfg.moe_num_experts
+    k = cfg.moe_top_k
+    C = moe_capacity(cfg, n_tokens)
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)          # [T,k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=probs.dtype)  # [T,k,E]
+    # Choice-major flatten: [k*T, E], first choices of all tokens first.
+    flat = onehot.transpose(1, 0, 2).reshape(k * n_tokens, E)
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat)        # [kT, E]
+    pos = (pos_in_expert * flat).sum(-1).astype(jnp.int32)   # [kT]
+    keep = (pos < C).astype(probs.dtype)
+    slot = jax.nn.one_hot(pos, C, dtype=probs.dtype)         # [kT, C]
+    disp_flat = flat[:, :, None] * slot[:, None, :] * keep[:, None, None]
+    dispatch = disp_flat.reshape(k, n_tokens, E, C).sum(0)   # [T,E,C]
+    weights = gate_vals.transpose(1, 0).reshape(k * n_tokens)
+    comb_flat = disp_flat * weights[:, None, None]
+    combine = comb_flat.reshape(k, n_tokens, E, C).sum(0)    # [T,E,C]
+
+    # Switch load-balance loss: E * sum_e mean_prob_e * mean_assigned_e
+    # (first-choice assignment fraction), minimized by uniform routing.
+    me = probs.mean(axis=0)                                  # [E]
+    first = jax.nn.one_hot(gate_idx[:, 0], E, dtype=probs.dtype)
+    ce = first.mean(axis=0)                                  # [E]
+    aux = (E * (me * ce).sum()).astype(jnp.float32)
+    return dispatch, combine, aux
+
+
+def moe_block(cfg, p, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN over x [b, s, h] -> (out [b, s, h], aux loss scalar)."""
+    ad = cfg.activation_dtype
+    b, s, h = x.shape
+    T = b * s
+    xt = x.reshape(T, h)
+
+    # Router in f32: routing decisions are precision-sensitive.
+    logits = jnp.einsum("th,he->te", xt.astype(jnp.float32),
+                        p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, aux = _dispatch_combine(cfg, probs, T)
+    dispatch = dispatch.astype(ad)
+    combine = combine.astype(ad)
+
+    # Token exchange: with E sharded over the "expert" axis and T over
+    # data/fsdp, these contractions are the all-to-alls.
+    expert_in = jnp.einsum("tec,th->ech", dispatch, xt.astype(ad),
+                           preferred_element_type=jnp.float32).astype(ad)
+    expert_in = with_logical_constraint(
+        expert_in, ("act_experts", None, None))
+
+    from runbooks_tpu.models.transformer import _activation
+
+    gate = jnp.einsum("ech,ehm->ecm", expert_in, p["wi_gate"].astype(ad),
+                      preferred_element_type=jnp.float32).astype(ad)
+    up = jnp.einsum("ech,ehm->ecm", expert_in, p["wi_up"].astype(ad),
+                    preferred_element_type=jnp.float32).astype(ad)
+    hidden = _activation(cfg, gate) * up
+    hidden = with_logical_constraint(
+        hidden, ("act_experts", None, "act_mlp"))
+    out_e = jnp.einsum("ecm,emh->ech", hidden, p["wo"].astype(ad),
+                       preferred_element_type=jnp.float32).astype(ad)
+
+    out = jnp.einsum("tec,ech->th", combine, out_e,
+                     preferred_element_type=jnp.float32).astype(ad)
+    return out.reshape(b, s, h), aux
+
+
+def moe_logical_axes():
+    """Logical axes for the stacked [L, ...] MoE params."""
+    return {
+        "router": ("layers", "embed", "experts"),
+        "wi_gate": ("layers", "experts", "embed", "mlp"),
+        "wi_up": ("layers", "experts", "embed", "mlp"),
+        "wo": ("layers", "experts", "mlp", "embed"),
+    }

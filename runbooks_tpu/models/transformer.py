@@ -92,21 +92,32 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
         layers["attn"]["q_norm"] = jnp.ones((L, cfg.head_dim), pd)
         layers["attn"]["k_norm"] = jnp.ones((L, cfg.head_dim), pd)
 
-    mlp: Params = {
-        "wo": _dense_init(next(keys), (L, cfg.intermediate_size, h), pd,
-                          cfg.intermediate_size),
-    }
-    if cfg.gated_mlp:
-        mlp["wi_gate"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
-        mlp["wi_up"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
+    if cfg.moe_num_experts:
+        assert cfg.gated_mlp, "MoE experts are gated (mixtral-style)"
+        E, m = cfg.moe_num_experts, cfg.intermediate_size
+        layers["moe"] = {
+            "router": (jax.random.normal(next(keys), (L, h, E))
+                       * h ** -0.5).astype(pd),
+            "wi_gate": _dense_init(next(keys), (L, E, h, m), pd, h),
+            "wi_up": _dense_init(next(keys), (L, E, h, m), pd, h),
+            "wo": _dense_init(next(keys), (L, E, m, h), pd, m),
+        }
     else:
-        mlp["wi"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
-    if cfg.mlp_bias:
-        for k in ("wi_gate", "wi_up", "wi"):
-            if k in mlp:
-                mlp["b" + k[1:]] = jnp.zeros((L, cfg.intermediate_size), pd)
-        mlp["bo"] = jnp.zeros((L, h), pd)
-    layers["mlp"] = mlp
+        mlp: Params = {
+            "wo": _dense_init(next(keys), (L, cfg.intermediate_size, h), pd,
+                              cfg.intermediate_size),
+        }
+        if cfg.gated_mlp:
+            mlp["wi_gate"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
+            mlp["wi_up"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
+        else:
+            mlp["wi"] = _dense_init(next(keys), (L, h, cfg.intermediate_size), pd, h)
+        if cfg.mlp_bias:
+            for k in ("wi_gate", "wi_up", "wi"):
+                if k in mlp:
+                    mlp["b" + k[1:]] = jnp.zeros((L, cfg.intermediate_size), pd)
+            mlp["bo"] = jnp.zeros((L, h), pd)
+        layers["mlp"] = mlp
 
     if not (cfg.parallel_block and cfg.shared_layer_norm):
         layers["ln2"] = _norm_params(cfg, (L,))
@@ -129,33 +140,44 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
     if not cfg.tie_embeddings:
         axes["head"] = ("embed", "vocab")
 
+    # The stacked-layer leading dim carries the "layers" logical axis: it
+    # maps to the "stage" mesh axis for pipeline parallelism and drops to
+    # replicated on meshes without one (parallel/sharding.py rules).
     attn = {
-        "wq": (None, "embed", "heads"),
-        "wk": (None, "embed", "kv_heads"),
-        "wv": (None, "embed", "kv_heads"),
-        "wo": (None, "heads", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
     }
     if cfg.attn_bias:
-        attn.update({"bq": (None, "heads"), "bk": (None, "kv_heads"),
-                     "bv": (None, "kv_heads"), "bo": (None, "norm")})
+        attn.update({"bq": ("layers", "heads"),
+                     "bk": ("layers", "kv_heads"),
+                     "bv": ("layers", "kv_heads"),
+                     "bo": ("layers", "norm")})
     if cfg.qk_norm:
-        attn.update({"q_norm": (None, "head_dim"), "k_norm": (None, "head_dim")})
+        attn.update({"q_norm": ("layers", "head_dim"),
+                     "k_norm": ("layers", "head_dim")})
 
-    mlp = {"wo": (None, "mlp", "embed")}
-    if cfg.gated_mlp:
-        mlp.update({"wi_gate": (None, "embed", "mlp"),
-                    "wi_up": (None, "embed", "mlp")})
+    if cfg.moe_num_experts:
+        from runbooks_tpu.models.moe import moe_logical_axes
+        ffn_key, ffn_axes = "moe", moe_logical_axes()
     else:
-        mlp["wi"] = (None, "embed", "mlp")
-    if cfg.mlp_bias:
-        for k in list(mlp):
-            if k.startswith("wi"):
-                mlp["b" + k[1:]] = (None, "mlp")
-        mlp["bo"] = (None, "norm")
+        mlp = {"wo": ("layers", "mlp", "embed")}
+        if cfg.gated_mlp:
+            mlp.update({"wi_gate": ("layers", "embed", "mlp"),
+                        "wi_up": ("layers", "embed", "mlp")})
+        else:
+            mlp["wi"] = ("layers", "embed", "mlp")
+        if cfg.mlp_bias:
+            for k in list(mlp):
+                if k.startswith("wi"):
+                    mlp["b" + k[1:]] = ("layers", "mlp")
+            mlp["bo"] = ("layers", "norm")
+        ffn_key, ffn_axes = "mlp", mlp
 
-    layers = {"attn": attn, "mlp": mlp, "ln1": norm1((None,))}
+    layers = {"attn": attn, ffn_key: ffn_axes, "ln1": norm1(("layers",))}
     if not (cfg.parallel_block and cfg.shared_layer_norm):
-        layers["ln2"] = norm1((None,))
+        layers["ln2"] = norm1(("layers",))
     axes["layers"] = layers
     return axes
 
@@ -362,9 +384,18 @@ def _mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
     return out
 
 
+def _ffn_block(cfg: ModelConfig, layer: Params, x: jax.Array):
+    """Dense MLP or MoE, returning (out, aux-loss scalar)."""
+    if cfg.moe_num_experts:
+        from runbooks_tpu.models.moe import moe_block
+
+        return moe_block(cfg, layer["moe"], x)
+    return _mlp_block(cfg, layer["mlp"], x), jnp.zeros((), jnp.float32)
+
+
 def _block(cfg: ModelConfig, layer: Params, x, positions, segment_ids, mask,
            bias, layer_cache):
-    """One transformer block. x: [b, s, h]."""
+    """One transformer block. x: [b, s, h]. Returns (x, cache, aux)."""
     x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
     h1 = _norm(cfg, layer["ln1"], x)
     attn_out, new_cache = _attention_block(
@@ -372,14 +403,15 @@ def _block(cfg: ModelConfig, layer: Params, x, positions, segment_ids, mask,
         layer_cache)
     if cfg.parallel_block:
         h2 = h1 if cfg.shared_layer_norm else _norm(cfg, layer["ln2"], x)
-        mlp_out = _mlp_block(cfg, layer["mlp"], h2)
+        mlp_out, aux = _ffn_block(cfg, layer, h2)
         x = x + attn_out + mlp_out
     else:
         x = x + attn_out
         h2 = _norm(cfg, layer["ln2"], x)
-        x = x + _mlp_block(cfg, layer["mlp"], h2)
+        ffn_out, aux = _ffn_block(cfg, layer, h2)
+        x = x + ffn_out
     x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
-    return x, new_cache
+    return x, new_cache, aux
 
 
 # ---------------------------------------------------------------------------
@@ -395,8 +427,11 @@ def forward(
     segment_ids: Optional[jax.Array] = None,  # [b, s] packed-seq ids (0 = pad)
     cache: Optional[KVCache] = None,
     remat: bool = False,
+    with_aux: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
-    """Returns (logits [b, s, vocab] float32, updated cache or None).
+    """Returns (logits [b, s, vocab] float32, updated cache or None) — or,
+    with_aux=True, (logits, cache, aux) where aux is the summed per-layer
+    auxiliary loss (MoE load balance; 0.0 for dense models).
 
     Without cache: standard training/eval forward, causal + segment masking.
     With cache: tokens are appended at cache.index (prefill chunks or single-
@@ -466,24 +501,48 @@ def forward(
             static_argnums=(0,))
 
     def scan_body(carry, scanned):
-        x = carry
+        x, aux_sum = carry
         if cache is not None:
             layer, ck, cv = scanned
             layer_cache = (ck, cv, None if scatter_mode else cache.index)
         else:
             layer = scanned
             layer_cache = None
-        x, new_cache = block(cfg, layer, x, positions, segment_ids, mask,
-                             bias, layer_cache)
-        return x, new_cache
+        x, new_cache, aux = block(cfg, layer, x, positions, segment_ids,
+                                  mask, bias, layer_cache)
+        return (x, aux_sum + aux), new_cache
 
+    aux_total = jnp.zeros((), jnp.float32)
     if cache is not None:
-        x, (new_k, new_v) = jax.lax.scan(
-            scan_body, x, (params["layers"], cache.k, cache.v))
+        (x, aux_total), (new_k, new_v) = jax.lax.scan(
+            scan_body, (x, aux_total), (params["layers"], cache.k, cache.v))
         new_index = cache.index if scatter_mode else cache.index + s
         new_cache = KVCache(k=new_k, v=new_v, index=new_index)
     else:
-        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        from runbooks_tpu.parallel.sharding import _current_mesh
+
+        mesh = _current_mesh()
+        n_stages = int(mesh.shape.get("stage", 1)) if mesh is not None \
+            else 1
+        if n_stages > 1:
+            # Pipeline-parallel path: same block, stacked layers sharded
+            # over the stage axis, activations ppermuted between stages
+            # (parallel/pipeline.py).
+            from runbooks_tpu.parallel.pipeline import pipeline_apply
+
+            def pipe_block(layer, xx, mb_consts):
+                pos, seg, mk, bs = mb_consts
+                y, _, aux = block(cfg, layer, xx, pos, seg, mk, bs, None)
+                return y, aux
+
+            x, aux_total = pipeline_apply(
+                pipe_block, params["layers"], x,
+                (positions, segment_ids, mask, bias),
+                mesh=mesh, n_stages=n_stages,
+                n_microbatches=cfg.pipeline_microbatches or None)
+        else:
+            (x, aux_total), _ = jax.lax.scan(
+                scan_body, (x, aux_total), params["layers"])
         new_cache = None
 
     x = _norm(cfg, params["final_norm"], x)
@@ -492,6 +551,8 @@ def forward(
                         head.astype(jnp.float32),
                         preferred_element_type=jnp.float32)
     logits = with_logical_constraint(logits, ("batch", "seq", None))
+    if with_aux:
+        return logits, new_cache, aux_total
     return logits, new_cache
 
 

@@ -27,16 +27,27 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh
 
-MESH_AXES = ("data", "fsdp", "sequence", "tensor")
+MESH_AXES = ("data", "stage", "expert", "fsdp", "sequence", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Parallelism degrees. Use -1 for at most one axis to mean "fill with
     whatever devices remain" (like the reference's implicit single-axis
-    gpu.count, but over a real mesh)."""
+    gpu.count, but over a real mesh).
+
+    stage  — pipeline parallelism (parallel/pipeline.py): the stacked-layer
+             leading dim shards over stages; activations flow stage->stage
+             via ppermute. Cross-stage traffic is one activation tensor per
+             microbatch tick, so the stage axis sits outermost after data
+             (it tolerates the slowest links — even DCN).
+    expert — expert parallelism for MoE layers (models/moe.py): the expert
+             leading dim shards over this axis; tokens route via all-to-all.
+    """
 
     data: int = 1
+    stage: int = 1
+    expert: int = 1
     fsdp: int = -1
     sequence: int = 1
     tensor: int = 1

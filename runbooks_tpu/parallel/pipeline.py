@@ -1,0 +1,129 @@
+"""Pipeline parallelism: GPipe-style microbatching over a "stage" mesh axis.
+
+TPU-native design (SURVEY §2a: the reference has no parallelism engine to
+port): the transformer already stores its layers *stacked* and scans over
+them, so pipelining is a resharding of that same structure — the stacked
+leading dim shards over the ``stage`` mesh axis, and the forward becomes an
+SPMD loop of S + M - 1 ticks in which every stage runs its layer block on
+its current microbatch and ``lax.ppermute``s the activations to the next
+stage. No per-stage programs, no explicit schedules: one jitted SPMD
+computation, differentiable end-to-end (the transpose of ppermute is the
+reverse permute, so jax.grad yields the exact pipelined backward).
+
+Bubble fraction is the usual (S-1)/(S+M-1); pick microbatches >= stages.
+During fill/drain, stages compute on garbage rows — wasted FLOPs, bought
+for compiler simplicity (static shapes, no data-dependent control flow:
+the XLA-friendly trade).
+
+Used by models/transformer.forward when the active mesh has stage > 1 (the
+no-cache path; decode pipelining is a serving-engine concern, not a
+training one).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _mb_index(tree, idx):
+    """Select microbatch idx (traced ok) from arrays shaped [M, ...]."""
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, idx, axis=0,
+                                               keepdims=False),
+        tree)
+
+
+def pipeline_apply(
+    block_fn: Callable,                # (layer, x, consts_mb) -> (x, aux)
+    layers: Any,                       # pytree, leaves [L, ...], L = S*Lps
+    x: jax.Array,                      # [b, s, h] embedded activations
+    consts: Any,                       # pytree of [b, ...] per-batch consts
+    *,
+    mesh,
+    n_stages: int,
+    n_microbatches: Optional[int] = None,
+    axis: str = "stage",
+):
+    """Run the layer stack as a pipeline; returns (activations [b, s, h],
+    aux-loss scalar — per-layer aux summed over layers, averaged over
+    microbatches).
+
+    block_fn runs ONE layer; each stage scans it over its L/S local layers.
+    consts is a pytree of batch-leading arrays (positions, masks, ...)
+    microbatched alongside x; None leaves pass through.
+    """
+    S = n_stages
+    M = n_microbatches or S
+    b = x.shape[0]
+    L = jax.tree.leaves(layers)[0].shape[0]
+    if L % S:
+        raise ValueError(f"{L} layers not divisible by {S} pipeline stages")
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+
+    def to_mb(a):
+        return a.reshape((M, b // M) + a.shape[1:])
+
+    x_mb = to_mb(x)
+    consts_mb = jax.tree.map(to_mb, consts)
+
+    def stage_fn(layers_local, x_mb, consts_mb):
+        stage = jax.lax.axis_index(axis)
+
+        def run_block(x, mb_consts):
+            def scan_body(carry, layer):
+                y, aux_sum = carry
+                y, aux = block_fn(layer, y, mb_consts)
+                return (y, aux_sum + aux), None
+            (y, aux), _ = jax.lax.scan(
+                scan_body, (x, jnp.zeros((), jnp.float32)), layers_local)
+            return y, aux
+
+        recv = jnp.zeros_like(x_mb[0])
+        out_buf = jnp.zeros_like(x_mb)
+        aux_total = jnp.zeros((), jnp.float32)
+        for t in range(S + M - 1):
+            # Stage s works on microbatch t - s at tick t (when in range);
+            # stage 0 feeds fresh microbatches, others consume upstream
+            # activations from the previous tick's ppermute.
+            feed_idx = min(t, M - 1)
+            inp = jnp.where(stage == 0, x_mb[feed_idx], recv)
+            mb_idx = jnp.clip(t - stage, 0, M - 1)
+            out, aux = run_block(inp, _mb_index(consts_mb, mb_idx))
+            # Fill/drain ticks compute on garbage rows; only in-range
+            # microbatches contribute aux.
+            valid = jnp.logical_and(t - stage >= 0,
+                                    t - stage <= M - 1)
+            aux_total = aux_total + jnp.where(valid, aux, 0.0)
+            # Last stage banks its result. Clamped static index: before the
+            # pipeline fills (t < S-1) this writes garbage to slot 0, which
+            # the real microbatch-0 result overwrites at t = S-1.
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, out, max(t - (S - 1), 0), axis=0)
+            if t < S + M - 2:
+                recv = jax.lax.ppermute(
+                    out, axis, [(i, (i + 1) % S) for i in range(S)])
+        # Everyone returns the last stage's buffer (masked psum broadcast),
+        # so the head/loss runs replicated over the stage axis.
+        is_last = (stage == S - 1).astype(out_buf.dtype)
+        # aux: every stage saw every microbatch once -> psum over stages
+        # sums over layers; divide by M for the per-batch mean.
+        return (jax.lax.psum(out_buf * is_last, axis),
+                jax.lax.psum(aux_total, axis) / M)
+
+    # Manual only over the stage axis: data/fsdp/sequence/tensor sharding
+    # inside the stage body stays with the GSPMD partitioner.
+    layer_specs = jax.tree.map(lambda _: P(axis), layers)
+    const_specs = jax.tree.map(lambda _: P(), consts_mb)
+    out, aux = jax.shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(layer_specs, P(), const_specs),
+        out_specs=(P(), P()),
+        axis_names={axis},
+        check_vma=False,
+    )(layers, x_mb, consts_mb)
+    return out.reshape((b,) + x.shape[1:]), aux

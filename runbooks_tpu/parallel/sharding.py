@@ -29,6 +29,9 @@ DEFAULT_RULES: Dict[str, MeshAssignment] = {
     "act_heads": "tensor",
     "act_mlp": "tensor",
     # Parameters
+    "layers": "stage",          # pipeline parallelism: stacked-layer leading
+                                # dim shards over stages (dropped on meshes
+                                # without a stage axis)
     "embed": "fsdp",            # ZeRO-3 shards the embed axis of every matrix
     "vocab": "tensor",
     "heads": "tensor",          # megatron: split attention over heads
@@ -37,6 +40,9 @@ DEFAULT_RULES: Dict[str, MeshAssignment] = {
     "mlp": "tensor",            # megatron: split ffn over hidden
     "norm": None,
     "pos": None,
+    # MoE
+    "experts": "expert",        # expert parallelism: expert leading dim
+    "act_experts": "expert",
 }
 
 
@@ -85,10 +91,18 @@ def spec_for_array(
     mesh: Mesh,
     rules: Optional[Dict[str, MeshAssignment]] = None,
 ) -> P:
-    """PartitionSpec for a concrete shape: drops mesh axes that don't divide."""
+    """PartitionSpec for a concrete shape: drops mesh axes that are absent
+    from the mesh (e.g. "stage"/"expert" on a plain DP/TP mesh) or that
+    don't divide the dimension."""
     base = logical_to_spec(logical, rules)
     out = []
     for dim, axes in zip(shape, tuple(base) + (None,) * (len(shape) - len(base))):
+        if axes is not None:  # drop mesh axes this mesh doesn't have
+            present = tuple(a for a in
+                            ((axes,) if isinstance(axes, str) else axes)
+                            if a in mesh.shape)
+            axes = (present[0] if len(present) == 1
+                    else (present or None))
         if axes is not None and not _divisible(dim, axes, mesh):
             # Try dropping trailing axes of a tuple assignment before giving up.
             if isinstance(axes, tuple):

@@ -153,10 +153,12 @@ class EngineWorker:
 def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   max_slots: int = 8,
                   max_seq_len: Optional[int] = None,
-                  mesh=None, warmup: bool = False) -> web.Application:
+                  mesh=None, warmup: bool = False,
+                  prefill_budget: Optional[int] = None) -> web.Application:
     tokenizer = tokenizer or load_tokenizer(None)
     engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
-                             max_seq_len=max_seq_len, mesh=mesh)
+                             max_seq_len=max_seq_len, mesh=mesh,
+                             prefill_budget=prefill_budget)
     if warmup:
         engine.warmup()  # pre-compile all buckets before readiness flips
     worker = EngineWorker(engine)
@@ -498,7 +500,9 @@ def main() -> int:
         max_slots=int(params.get("max_slots", 8)),
         max_seq_len=params.get("max_seq_len"),
         mesh=mesh,
-        warmup=bool(params.get("warmup", True)))
+        warmup=bool(params.get("warmup", True)),
+        prefill_budget=(int(params["prefill_budget"])
+                        if params.get("prefill_budget") else None))
     port = int(params.get("port", contract.SERVE_PORT))
     web.run_app(app, port=port, print=lambda *a: None)
     return 0

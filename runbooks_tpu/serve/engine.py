@@ -69,14 +69,29 @@ class InferenceEngine:
 
     def __init__(self, cfg: ModelConfig, params: Params, *,
                  max_slots: int = 8, max_seq_len: Optional[int] = None,
-                 seed: int = 0, mesh=None):
+                 seed: int = 0, mesh=None,
+                 prefill_budget: Optional[int] = None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
         and kv-heads over tensor. All jitted steps then run SPMD under the
-        mesh; XLA inserts the per-layer collectives."""
+        mesh; XLA inserts the per-layer collectives.
+
+        prefill_budget: max prompt tokens (bucket-padded) admitted per
+        step. Prefills run serially before the step's decode, so an
+        unbounded admission burst stalls every in-flight request's next
+        token; the budget spreads a burst over steps, bounding inter-token
+        latency while decode throughput continues. Default: max_seq_len
+        (≈ one full-length prefill worth per step). A single over-budget
+        request still admits alone — the budget shapes bursts, it never
+        starves."""
         self.cfg = cfg
         self.mesh = mesh
+        self.prefill_budget = prefill_budget
+        if mesh is not None and int(mesh.shape.get("stage", 1)) > 1:
+            raise ValueError(
+                "pipeline (stage) parallelism is a training-path feature; "
+                "serve with tensor/data parallelism instead (mesh_tensor)")
         if mesh is not None:
             import contextlib
 
@@ -117,6 +132,8 @@ class InferenceEngine:
                                  self._cache_sharding(self.cache.v.shape)),
                 index=self.cache.index)
         self._pad_slot = self.max_seq_len  # trash slot index
+        if self.prefill_budget is None:
+            self.prefill_budget = self.max_seq_len
         self.lengths = np.zeros(max_slots, np.int32)       # tokens in cache
         self.active = np.zeros(max_slots, bool)
         self.last_token = np.zeros(max_slots, np.int32)
@@ -233,10 +250,20 @@ class InferenceEngine:
         return self.prefill_buckets[-1]
 
     def _admit(self) -> None:
+        budget = self.prefill_budget
+        admitted = 0
         for slot in self._free_slots():
             if not self.queue:
                 break
+            # Budget in bucket-padded tokens (what the prefill actually
+            # computes). The first admission always goes through so an
+            # over-budget prompt cannot starve.
+            need = self._bucket_for(len(self.queue[0].prompt_tokens))
+            if admitted and need > budget:
+                break
             req = self.queue.pop(0)
+            budget -= need
+            admitted += 1
             self._prefill_into(slot, req)
 
     def _prefill_into(self, slot: int, req: Request) -> None:

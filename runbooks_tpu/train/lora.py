@@ -154,14 +154,19 @@ def make_lora_train_step(model_cfg, lora_cfg: LoraConfig, optimizer, mesh,
     def step_fn(state: "TrainState", base_params, batch):
         def loss_fn(lora):
             merged = apply_lora(base_params, lora, lora_cfg)
-            logits, _ = forward(
+            logits, _, aux = forward(
                 model_cfg, merged, batch["tokens"],
                 positions=batch.get("positions"),
                 segment_ids=batch.get("segment_ids"),
                 remat=remat,
+                with_aux=True,
             )
             loss, total = cross_entropy_loss(
                 logits, batch["targets"], batch.get("loss_mask"))
+            if model_cfg.moe_num_experts:
+                # Same objective as full fine-tuning: keep routing balanced
+                # while adapting (train/step.py does the same).
+                loss = loss + model_cfg.moe_aux_coef * aux
             return loss, total
 
         (loss, total), grads = jax.value_and_grad(

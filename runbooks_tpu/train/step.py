@@ -134,14 +134,17 @@ def make_train_step(
 
     def step_fn(state: TrainState, batch: Batch):
         def loss_fn(params):
-            logits, _ = forward(
+            logits, _, aux = forward(
                 cfg, params, batch["tokens"],
                 positions=batch.get("positions"),
                 segment_ids=batch.get("segment_ids"),
                 remat=remat,
+                with_aux=True,
             )
             loss, total = cross_entropy_loss(
                 logits, batch["targets"], batch.get("loss_mask"))
+            if cfg.moe_num_experts:
+                loss = loss + cfg.moe_aux_coef * aux
             return loss, total
 
         (loss, total_weight), grads = jax.value_and_grad(

@@ -1,0 +1,125 @@
+"""Pipeline-parallelism tests: the GPipe-over-stage-axis path must be
+numerically identical to the plain layer scan (same params, same batch),
+forward and backward, and must compose with the train step.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from runbooks_tpu.models.config import get_config
+from runbooks_tpu.models.transformer import forward, init_params
+from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def pp_cfg(**over):
+    kw = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+              num_layers=4, num_heads=4, num_kv_heads=4, head_dim=8,
+              max_seq_len=16, dtype="float32")
+    kw.update(over)
+    return get_config("debug", **kw)
+
+
+def batch_tokens(cfg, b=8, s=12, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
+
+
+def test_pipeline_forward_matches_plain():
+    cfg = pp_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg)
+
+    plain_mesh = make_mesh(MeshConfig(data=2, fsdp=4))
+    with jax.set_mesh(plain_mesh):
+        want, _ = jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)
+
+    pp_mesh = make_mesh(MeshConfig(data=2, stage=4, fsdp=1))
+    with jax.set_mesh(pp_mesh):
+        got, _ = jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_more_microbatches_than_stages():
+    cfg = pp_cfg(pipeline_microbatches=4)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg)
+
+    plain = make_mesh(MeshConfig(fsdp=8))
+    with jax.set_mesh(plain):
+        want, _ = jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)
+
+    pp_mesh = make_mesh(MeshConfig(stage=2, fsdp=4))
+    with jax.set_mesh(pp_mesh):
+        got, _ = jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_plain():
+    cfg = pp_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg)
+    targets = batch_tokens(cfg, seed=1)
+
+    def loss_fn(p, t, y):
+        logits, _ = forward(cfg, p, t)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
+
+    plain_mesh = make_mesh(MeshConfig(fsdp=8))
+    with jax.set_mesh(plain_mesh):
+        want = jax.jit(jax.grad(loss_fn))(params, tokens, targets)
+
+    pp_mesh = make_mesh(MeshConfig(stage=4, fsdp=2))
+    with jax.set_mesh(pp_mesh):
+        got = jax.jit(jax.grad(loss_fn))(params, tokens, targets)
+
+    flat_w, _ = jax.tree.flatten(want)
+    flat_g, _ = jax.tree.flatten(got)
+    for w, g in zip(flat_w, flat_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_train_step_runs():
+    from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
+    from runbooks_tpu.train.step import create_train_state, make_train_step
+
+    cfg = pp_cfg()
+    mesh = make_mesh(MeshConfig(data=2, stage=2, fsdp=1, tensor=2))
+    opt = make_optimizer(OptimizerConfig(total_steps=4, warmup_steps=0))
+    state, shardings = create_train_state(cfg, opt, mesh, jax.random.key(0))
+    step = make_train_step(cfg, opt, mesh, shardings)
+
+    tokens = np.asarray(batch_tokens(cfg, b=8, s=13))
+    batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:],
+             "loss_mask": np.ones((8, 12), np.float32)}
+    with jax.set_mesh(mesh):
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # actually learning through the pipeline
+
+    # Layer params really are stage-sharded (the point of PP: per-device
+    # parameter memory drops by the stage factor).
+    wq = state.params["layers"]["attn"]["wq"]
+    assert wq.sharding.spec[0] == "stage"
+
+
+def test_pipeline_rejects_indivisible():
+    cfg = pp_cfg(num_layers=3)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg)
+    mesh = make_mesh(MeshConfig(stage=2, fsdp=4))
+    with jax.set_mesh(mesh):
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)

@@ -304,3 +304,45 @@ def test_http_streaming_sse():
             assert sum(1 for d in deltas if "role" in d) == 1
 
     asyncio.run(drive())
+
+
+def test_engine_prefill_budget_spreads_admission():
+    """A burst of prompts is admitted over multiple steps bounded by the
+    per-step prefill-token budget (bucket-padded), so in-flight decodes
+    keep making progress during the burst; a single over-budget prompt
+    still admits alone (no starvation)."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    eng = InferenceEngine(cfg, params, max_slots=4, prefill_budget=32)
+
+    # 3 prompts of 20 tokens -> bucket 32 each: one admission per step.
+    reqs = [Request(prompt_tokens=list(range(1, 21)), max_tokens=10,
+                    temperature=0.0) for _ in range(3)]
+    for r in reqs:
+        eng.submit(r)
+    eng.step()
+    assert int(eng.active.sum()) == 1 and len(eng.queue) == 2
+    eng.step()
+    assert int(eng.active.sum()) == 2 and len(eng.queue) == 1
+    eng.step()
+    assert int(eng.active.sum()) == 3 and not eng.queue
+    # Earlier admissions kept decoding while later ones waited their turn.
+    assert [len(r.output_tokens) for r in reqs] == [4, 3, 2]
+    while eng.has_work():
+        eng.step()
+    assert all(len(r.output_tokens) == 10 for r in reqs)
+
+    # Over-budget single prompt (bucket 64 > 32) admits immediately.
+    eng.submit(Request(prompt_tokens=list(range(1, 41)), max_tokens=2,
+                       temperature=0.0))
+    eng.step()
+    assert not eng.queue  # admitted despite exceeding the budget
+
+    # Short prompts (bucket 16) pack two-per-step under the same budget.
+    while eng.has_work():
+        eng.step()
+    for _ in range(4):
+        eng.submit(Request(prompt_tokens=[1, 2, 3], max_tokens=10,
+                           temperature=0.0))
+    eng.step()
+    assert int(eng.active.sum()) == 2 and len(eng.queue) == 2
