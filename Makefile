@@ -36,3 +36,40 @@ nbwatch:
 .PHONY: test-system
 test-system:
 	$(TEST_ENV) python test/system.py
+
+# --- Dev loop (reference analog: skaffold.{gcp,kind}.yaml + the Makefile
+# dev-run hybrid mode: controller runs LOCALLY against the cluster in the
+# current kubeconfig context, so reconciler changes need no image build).
+
+.PHONY: skaffold-local skaffold-gcp
+skaffold-local:
+	skaffold dev -f skaffold.local.yaml
+skaffold-gcp:
+	skaffold dev -f skaffold.gcp.yaml
+
+.PHONY: dev-run-local
+dev-run-local: export CLOUD=local
+dev-run-local: export SCI_ADDRESS=localhost:10080
+dev-run-local: export CLUSTER_NAME=local
+dev-run-local: export ARTIFACT_BUCKET_URL=file:///tmp/runbooks-tpu-bucket
+dev-run-local: export REGISTRY_URL=localhost:5000
+dev-run-local:
+	kubectl scale -n runbooks-tpu deploy/controller-manager --replicas 0 || true
+	python -m runbooks_tpu.controller.main
+
+.PHONY: dev-run-gcp
+dev-run-gcp: export CLOUD=gcp
+dev-run-gcp: export PROJECT_ID=$(shell gcloud config get-value project)
+dev-run-gcp: export CLUSTER_NAME=runbooks-tpu
+dev-run-gcp: export PRINCIPAL=runbooks-tpu@$(PROJECT_ID).iam.gserviceaccount.com
+dev-run-gcp: export SCI_ADDRESS=localhost:10080
+dev-run-gcp:
+	kubectl scale -n runbooks-tpu deploy/controller-manager --replicas 0 || true
+	# One shell: tunnel + controller, tunnel torn down when the controller
+	# exits; wait for the tunnel to listen before starting.
+	bash -c 'kubectl port-forward -n runbooks-tpu svc/sci 10080:10080 & \
+	  pf=$$!; trap "kill $$pf 2>/dev/null" EXIT; \
+	  for i in $$(seq 20); do \
+	    (exec 3<>/dev/tcp/127.0.0.1/10080) 2>/dev/null && break; sleep 0.5; \
+	  done; \
+	  python -m runbooks_tpu.controller.main'

@@ -277,6 +277,7 @@ class K8sClient:
             import sys
             import time
 
+            last_log = -1e9
             resource_version = ""
             while True:
                 query = "watch=true&allowWatchBookmarks=true"
@@ -312,8 +313,14 @@ class K8sClient:
                                 continue
                             sub.put(etype, obj)
                 except Exception as e:  # noqa: BLE001 — reconnect loop
-                    print(f"watch {kind}: reconnecting after {e!r}",
-                          file=sys.stderr)
+                    # Rate-limit the reconnect log: a dead apiserver (or a
+                    # test server that shut down) would otherwise spam a
+                    # line every 2s from this daemon thread.
+                    now = time.monotonic()
+                    if now - last_log > 30:
+                        last_log = now
+                        print(f"watch {kind}: reconnecting after {e!r}",
+                              file=sys.stderr)
                     time.sleep(2)
 
         threading.Thread(target=reader, daemon=True).start()

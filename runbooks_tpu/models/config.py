@@ -75,9 +75,12 @@ class ModelConfig:
 
     # Embedding lookup as one-hot matmul instead of gather. Under a
     # tensor-sharded vocab, GSPMD partitions the matmul cleanly where the
-    # gather forces a full rematerialization reshard; costs extra FLOPs, so
-    # it's a measured choice, not the default.
-    embed_one_hot: bool = False
+    # gather forces an involuntary full-remat reshard. Measured on the
+    # 8-way virtual mesh (fsdp2 x seq2 x tp2 train step): one-hot removes
+    # the all-to-all + all 3 collective-permutes and 3 all-gathers from
+    # the compiled HLO. None = auto (one-hot exactly when the active mesh
+    # has tensor > 1); True/False force.
+    embed_one_hot: Optional[bool] = None
 
     # Dtypes
     dtype: str = "bfloat16"           # activation dtype

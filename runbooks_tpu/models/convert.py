@@ -181,7 +181,58 @@ def convert_opt(cfg: ModelConfig, sd: StateDict) -> Dict:
     return params
 
 
+def convert_mixtral(cfg: ModelConfig, sd: StateDict) -> Dict:
+    """Mixtral = llama attention + per-layer MoE FFN. HF layout:
+    block_sparse_moe.gate.weight [E, h] (router) and
+    block_sparse_moe.experts.{e}.w1/w3/w2 (gate/up/down)."""
+    L, E = cfg.num_layers, cfg.moe_num_experts
+    p = lambda i, name: np.asarray(sd[f"model.layers.{i}.{name}"])
+
+    def expert(i, e, w):
+        return _t(p(i, f"block_sparse_moe.experts.{e}.{w}.weight"))
+
+    params = {
+        "embed": np.asarray(sd["model.embed_tokens.weight"]),
+        "final_norm": {"scale": np.asarray(sd["model.norm.weight"])},
+        "layers": {
+            "attn": {
+                "wq": _stack(_t(p(i, "self_attn.q_proj.weight"))
+                             for i in range(L)),
+                "wk": _stack(_t(p(i, "self_attn.k_proj.weight"))
+                             for i in range(L)),
+                "wv": _stack(_t(p(i, "self_attn.v_proj.weight"))
+                             for i in range(L)),
+                "wo": _stack(_t(p(i, "self_attn.o_proj.weight"))
+                             for i in range(L)),
+            },
+            "moe": {
+                "router": _stack(_t(p(i, "block_sparse_moe.gate.weight"))
+                                 for i in range(L)),      # [L, h, E]
+                "wi_gate": _stack(
+                    _stack(expert(i, e, "w1") for e in range(E))
+                    for i in range(L)),                   # [L, E, h, m]
+                "wi_up": _stack(
+                    _stack(expert(i, e, "w3") for e in range(E))
+                    for i in range(L)),
+                "wo": _stack(
+                    _stack(expert(i, e, "w2") for e in range(E))
+                    for i in range(L)),                   # [L, E, m, h]
+            },
+            "ln1": {"scale": _stack(p(i, "input_layernorm.weight")
+                                    for i in range(L))},
+            "ln2": {"scale": _stack(p(i, "post_attention_layernorm.weight")
+                                    for i in range(L))},
+        },
+    }
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        params["head"] = (_t(head) if head is not None
+                          else _t(params["embed"]))
+    return params
+
+
 CONVERTERS = {
+    "mixtral": convert_mixtral,  # before "llama": shares its attention
     "llama": convert_llama,
     "falcon": convert_falcon,
     "opt": convert_opt,
@@ -194,6 +245,8 @@ def family_of(cfg: ModelConfig) -> str:
         if fam in name:
             return fam
     # Structural fallback
+    if cfg.moe_num_experts:
+        return "mixtral"
     if cfg.parallel_block:
         return "falcon"
     if cfg.position_type == "learned":

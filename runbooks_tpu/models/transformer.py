@@ -459,7 +459,16 @@ def forward(
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
                                          (b, s))
 
-    if cfg.embed_one_hot:
+    use_one_hot = cfg.embed_one_hot
+    if use_one_hot is None:
+        # Auto: under tensor parallelism the vocab dim is TP-sharded and
+        # the one-hot matmul partitions cleanly where the gather forces a
+        # full-remat reshard (see ModelConfig.embed_one_hot).
+        from runbooks_tpu.parallel.sharding import _current_mesh
+
+        m0 = _current_mesh()
+        use_one_hot = m0 is not None and int(m0.shape.get("tensor", 1)) > 1
+    if use_one_hot:
         one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=ad)
         x = jnp.einsum("bsv,vh->bsh", one_hot, params["embed"].astype(ad),
                        preferred_element_type=jnp.float32).astype(ad)

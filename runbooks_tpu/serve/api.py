@@ -502,7 +502,8 @@ def main() -> int:
         mesh=mesh,
         warmup=bool(params.get("warmup", True)),
         prefill_budget=(int(params["prefill_budget"])
-                        if params.get("prefill_budget") else None))
+                        if params.get("prefill_budget") is not None
+                        else None))
     port = int(params.get("port", contract.SERVE_PORT))
     web.run_app(app, port=port, print=lambda *a: None)
     return 0

@@ -87,3 +87,26 @@ def test_opt_parity():
         mlp_bias=True, tie_embeddings=True, dtype="float32")
     tokens = np.random.default_rng(2).integers(0, 128, (2, 11))
     compare(cfg, hf, tokens)
+
+
+def test_mixtral_parity():
+    """HF Mixtral (llama attention + sparse MoE FFN) vs our MoE path. High
+    capacity factor => no token drops, so the top-2 routed output is exact
+    (HF routes densely per token with no capacity)."""
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    hf_cfg = MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        num_local_experts=4, num_experts_per_tok=2,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = MixtralForCausalLM(hf_cfg)
+    cfg = ModelConfig(
+        name="mixtral-test", vocab_size=128, hidden_size=64,
+        intermediate_size=96, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=64, dtype="float32",
+        moe_num_experts=4, moe_top_k=2, moe_capacity_factor=8.0)
+    tokens = np.random.default_rng(2).integers(0, 128, (2, 12))
+    compare(cfg, hf, tokens)
