@@ -123,3 +123,25 @@ def test_pipeline_rejects_indivisible():
     with jax.set_mesh(mesh):
         with pytest.raises(ValueError, match="not divisible"):
             jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)
+
+
+def test_pipeline_composes_with_ring_attention():
+    """SP (ring attention over the sequence axis) inside PP stages: nested
+    shard_map (stage manual outside, sequence manual inside) must match the
+    plain forward exactly."""
+    cfg = pp_cfg(attention_impl="ring")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg, b=4, s=8)
+
+    plain = make_mesh(MeshConfig(fsdp=8))
+    with jax.set_mesh(plain):
+        want, _ = jax.jit(lambda p, t: forward(
+            dataclasses.replace(cfg, attention_impl="xla"), p, t))(
+                params, tokens)
+
+    mesh = make_mesh(MeshConfig(stage=2, sequence=2, fsdp=2))
+    with jax.set_mesh(mesh):
+        got, _ = jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
