@@ -143,6 +143,18 @@ class Resource:
     def condition_true(self, ctype: str) -> bool:
         return ko.is_condition_true(self.obj, ctype)
 
+    def absorb(self, written: Dict[str, Any]) -> None:
+        """Absorb the resourceVersion of a server write (apply/update
+        result) so the next write in the same reconcile pass doesn't carry
+        a stale one — a real apiserver (and the fake, matching it) 409s
+        those."""
+        self.obj.setdefault("metadata", {})["resourceVersion"] = \
+            ko.deep_get(written, "metadata", "resourceVersion")
+
+    def commit_status(self, client) -> None:
+        """Write .status and absorb the new resourceVersion."""
+        self.absorb(client.update_status(self.obj))
+
 
 class Model(Resource):
     """A trained/imported model: running spec.command in spec.image writes

@@ -83,7 +83,7 @@ class BuildReconciler:
                 status["storedMD5"] = stored
                 changed = True
             if changed:
-                ctx.client.update_status(obj.obj)
+                obj.commit_status(ctx.client)
             return True
 
         # Need (or refresh) a signed URL for this requestID.
@@ -101,7 +101,7 @@ class BuildReconciler:
             obj.set_condition(cond.UPLOADED, False,
                               cond.REASON_AWAITING_UPLOAD,
                               "waiting for client to PUT the tarball")
-            ctx.client.update_status(obj.obj)
+            obj.commit_status(ctx.client)
         return False
 
     # ------------------------------------------------------------------
@@ -127,27 +127,27 @@ class BuildReconciler:
             job = self._build_job(ctx, obj, job_name, target_image)
             ctx.client.create(job)
             obj.set_condition(cond.BUILT, False, cond.REASON_BUILD_JOB_RUNNING)
-            ctx.client.update_status(obj.obj)
+            obj.commit_status(ctx.client)
             return Result(requeue_after=2.0)
 
         complete, failed = job_status(existing)
         if failed:
             obj.set_condition(cond.BUILT, False, cond.REASON_BUILD_JOB_FAILED,
                               f"build job {job_name} failed")
-            ctx.client.update_status(obj.obj)
+            obj.commit_status(ctx.client)
             return Result()
         if not complete:
             return Result(requeue_after=2.0)
 
         # Success: record the image on the spec + Built condition (:157-171).
         obj.set_image(target_image)
-        ctx.client.apply({
+        obj.absorb(ctx.client.apply({
             "apiVersion": API_VERSION, "kind": self.kind,
             "metadata": {"name": obj.name, "namespace": obj.namespace},
             "spec": {"image": target_image},
-        }, FIELD_MANAGER)
+        }, FIELD_MANAGER))
         obj.set_condition(cond.BUILT, True, cond.REASON_BUILT)
-        ctx.client.update_status(obj.obj)
+        obj.commit_status(ctx.client)
         return Result()
 
     def _build_job(self, ctx: Ctx, obj: Resource, job_name: str,

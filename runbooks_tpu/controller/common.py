@@ -160,12 +160,12 @@ def gate_dependency(ctx, obj: Resource, dep_kind: str, dep_name: str,
     if raw is None:
         obj.set_condition(gate_condition, False, not_found_reason,
                           f"{dep_kind} {dep_name!r} not found")
-        ctx.client.update_status(obj.obj)
+        obj.commit_status(ctx.client)
         return None, False
     dep = KIND_TO_CLASS[dep_kind](raw)
     if not dep.ready:
         obj.set_condition(gate_condition, False, not_ready_reason,
                           f"{dep_kind} {dep_name!r} not ready")
-        ctx.client.update_status(obj.obj)
+        obj.commit_status(ctx.client)
         return dep, False
     return dep, True

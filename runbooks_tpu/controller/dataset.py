@@ -31,7 +31,7 @@ class DatasetReconciler:
         reconcile_params_configmap(ctx.client, ds)
         if ds.artifacts_url != ctx.cloud.object_artifact_url(ds):
             ds.set_artifacts_url(ctx.cloud.object_artifact_url(ds))
-            ctx.client.update_status(ds.obj)
+            ds.commit_status(ctx.client)
         reconcile_service_account(ctx.client, ctx.cloud, ctx.sci,
                                   SA_DATA_LOADER, ds.namespace)
 
@@ -40,7 +40,7 @@ class DatasetReconciler:
         if existing is None:
             ctx.client.create(self._loader_job(ctx, ds, job_name))
             ds.set_condition(cond.COMPLETE, False, cond.REASON_JOB_RUNNING)
-            ctx.client.update_status(ds.obj)
+            ds.commit_status(ctx.client)
             return Result(requeue_after=2.0)
 
         complete, failed = job_status(existing)
@@ -48,7 +48,7 @@ class DatasetReconciler:
             ds.set_condition(cond.COMPLETE, False, cond.REASON_JOB_FAILED,
                              f"job {job_name} failed")
             ds.set_ready(False)
-            ctx.client.update_status(ds.obj)
+            ds.commit_status(ctx.client)
             return Result()
         if not complete:
             return Result(requeue_after=2.0)
@@ -59,7 +59,7 @@ class DatasetReconciler:
             ds.set_ready(True)
             changed = True
         if changed:
-            ctx.client.update_status(ds.obj)
+            ds.commit_status(ctx.client)
         return Result()
 
     def _loader_job(self, ctx: Ctx, ds: Dataset, job_name: str) -> dict:

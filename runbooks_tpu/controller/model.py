@@ -51,7 +51,7 @@ class ModelReconciler:
 
         if model.artifacts_url != ctx.cloud.object_artifact_url(model):
             model.set_artifacts_url(ctx.cloud.object_artifact_url(model))
-            ctx.client.update_status(model.obj)
+            model.commit_status(ctx.client)
 
         reconcile_service_account(ctx.client, ctx.cloud, ctx.sci,
                                   SA_MODELLER, model.namespace)
@@ -90,7 +90,7 @@ class ModelReconciler:
                     ko.set_owner(obj, model.obj)
                     ctx.client.create(obj)
             model.set_condition(cond.COMPLETE, False, cond.REASON_JOB_RUNNING)
-            ctx.client.update_status(model.obj)
+            model.commit_status(ctx.client)
             return Result(requeue_after=2.0)
 
         statuses = [job_status(j) for j in existing_jobs]
@@ -136,12 +136,12 @@ class ModelReconciler:
                     cond.COMPLETE, False, cond.REASON_JOB_RESTARTED,
                     f"slice restart {restarts + 1}/{limit}; resuming from "
                     "last checkpoint")
-                ctx.client.update_status(model.obj)
+                model.commit_status(ctx.client)
                 return Result(requeue_after=1.0)
             model.set_condition(cond.COMPLETE, False, cond.REASON_JOB_FAILED,
                                 f"job {job_name} failed")
             model.set_ready(False)
-            ctx.client.update_status(model.obj)
+            model.commit_status(ctx.client)
             return Result()
         if not complete:
             return Result(requeue_after=2.0)
@@ -152,7 +152,7 @@ class ModelReconciler:
             model.set_ready(True)
             changed = True
         if changed:
-            ctx.client.update_status(model.obj)
+            model.commit_status(ctx.client)
         if RESTARTS_ANNOTATION in ko.annotations(model.obj):
             # Success clears the restart budget: a future retrain starts
             # with a full maxRestarts, not the leftovers of this run.

@@ -54,7 +54,7 @@ class NotebookReconciler:
                 nb.set_ready(False)
                 changed = True
             if changed:
-                ctx.client.update_status(nb.obj)
+                nb.commit_status(ctx.client)
             return Result()
         else:
             nb.set_condition(cond.SUSPENDED, False, "Active")
@@ -95,7 +95,7 @@ class NotebookReconciler:
             ctx.client.create(pod)
             nb.set_condition(cond.COMPLETE, False, cond.REASON_POD_NOT_READY)
             nb.set_ready(False)
-            ctx.client.update_status(nb.obj)
+            nb.commit_status(ctx.client)
             return Result(requeue_after=2.0)
 
         ready = is_pod_ready(existing)
@@ -106,7 +106,7 @@ class NotebookReconciler:
             nb.set_ready(ready)
             changed = True
         if changed:
-            ctx.client.update_status(nb.obj)
+            nb.commit_status(ctx.client)
         return Result() if ready else Result(requeue_after=2.0)
 
     # ------------------------------------------------------------------

@@ -48,7 +48,7 @@ class ServerReconciler:
             server.set_condition(cond.SERVING, False,
                                  cond.REASON_MODEL_NOT_FOUND,
                                  "spec.model is required")
-            ctx.client.update_status(server.obj)
+            server.commit_status(ctx.client)
             return Result()
         model, ok = gate_dependency(
             ctx, server, "Model", server.model_ref,
@@ -83,7 +83,7 @@ class ServerReconciler:
             server.set_ready(serving)
             changed = True
         if changed:
-            ctx.client.update_status(server.obj)
+            server.commit_status(ctx.client)
         return Result() if serving else Result(requeue_after=2.0)
 
     # ------------------------------------------------------------------

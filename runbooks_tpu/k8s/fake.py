@@ -178,6 +178,13 @@ class FakeCluster:
             cur = self._objs.get(k)
             if cur is None:
                 raise NotFound(str(k))
+            # Real apiservers 409 a status PUT carrying a stale
+            # resourceVersion; matching that here keeps reconcilers honest
+            # (a previous fake that skipped this check masked exactly that
+            # bug class — write-then-stale-status-write).
+            rv = ko.deep_get(obj, "metadata", "resourceVersion")
+            if rv is not None and rv != cur["metadata"]["resourceVersion"]:
+                raise Conflict(f"status resourceVersion mismatch for {k}")
             cur["status"] = ko.clone(obj.get("status", {}))
             cur["metadata"]["resourceVersion"] = str(next(self._rv))
             self._notify("MODIFIED", cur)

@@ -188,6 +188,28 @@ def _opt(name, v=50272, h=768, i=3072, l=12, q=12, s=2048):
     )
 
 
+def _gemma(name, v=256000, h=2048, i=16384, l=18, q=8, kv=1, d=256, s=8192):
+    # Gemma: GeGLU (gated tanh-gelu), embeddings scaled by sqrt(h), tied
+    # head, RMSNorm with a (1 + w) scale (handled in the converter).
+    return ModelConfig(
+        name=name, vocab_size=v, hidden_size=h, intermediate_size=i,
+        num_layers=l, num_heads=q, num_kv_heads=kv, head_dim=d,
+        max_seq_len=s, norm_type="rmsnorm", norm_eps=1e-6, gated_mlp=True,
+        activation="gelu", position_type="rope", tie_embeddings=True,
+        embed_scale=True,
+    )
+
+
+def _gpt2(name, v=50257, h=768, i=3072, l=12, q=12, s=1024):
+    return ModelConfig(
+        name=name, vocab_size=v, hidden_size=h, intermediate_size=i,
+        num_layers=l, num_heads=q, num_kv_heads=q, head_dim=h // q,
+        max_seq_len=s, norm_type="layernorm", norm_eps=1e-5, gated_mlp=False,
+        activation="gelu", position_type="learned", attn_bias=True,
+        mlp_bias=True, tie_embeddings=True,
+    )
+
+
 # Registry mirrors the reference's documented example configs
 # (reference: examples/ tree — llama2-7b, llama2-70b, falcon-7b/40b,
 # facebook-opt-125m) plus debug sizes for tests/benchmarks.
@@ -215,6 +237,12 @@ CONFIGS = {
         _llama("mixtral-8x7b", v=32000, h=4096, i=14336, l=32, q=32, kv=8,
                d=128, s=32768, theta=1e6),
         moe_num_experts=8, moe_top_k=2),
+    # Gemma (MQA 2b / MHA 7b; GeGLU, scaled embeddings, tied head)
+    "gemma-2b": _gemma("gemma-2b"),
+    "gemma-7b": _gemma("gemma-7b", h=3072, i=24576, l=28, q=16, kv=16),
+    # GPT-2 (fused-qkv Conv1D checkpoints; learned positions)
+    "gpt2": _gpt2("gpt2"),
+    "gpt2-xl": _gpt2("gpt2-xl", h=1600, i=6400, l=48, q=25),
     # Debug/bench sizes
     "debug": _llama("debug", v=512, h=128, i=384, l=2, q=4, kv=2, d=32, s=256),
     "bench-1b": _llama("bench-1b", h=2048, i=5632, l=22, q=16, kv=16, d=128, s=2048),

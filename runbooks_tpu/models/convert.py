@@ -231,8 +231,66 @@ def convert_mixtral(cfg: ModelConfig, sd: StateDict) -> Dict:
     return params
 
 
+def convert_gemma(cfg: ModelConfig, sd: StateDict) -> Dict:
+    """Gemma uses llama key names but RMSNorm computes x * (1 + w): fold
+    the +1 into the stored scales. Head is tied to the embedding."""
+    params = convert_llama(cfg, sd)
+    params["final_norm"]["scale"] = params["final_norm"]["scale"] + 1.0
+    for ln in ("ln1", "ln2"):
+        params["layers"][ln]["scale"] = params["layers"][ln]["scale"] + 1.0
+    return params
+
+
+def convert_gpt2(cfg: ModelConfig, sd: StateDict) -> Dict:
+    """GPT-2: Conv1D weights are already [in, out] (no transpose), the
+    attention projection is a fused c_attn [h, 3h] split into q/k/v, and
+    learned positions have no row offset (unlike OPT's +2)."""
+    L, h = cfg.num_layers, cfg.hidden_size
+    g = lambda i, name: np.asarray(sd[f"transformer.h.{i}.{name}"])
+
+    def split_qkv(i):
+        w = g(i, "attn.c_attn.weight")        # [h, 3h]
+        b = g(i, "attn.c_attn.bias")          # [3h]
+        return (w[:, :h], w[:, h:2 * h], w[:, 2 * h:],
+                b[:h], b[h:2 * h], b[2 * h:])
+
+    qs, ks, vs, bqs, bks, bvs = zip(*(split_qkv(i) for i in range(L)))
+    return {
+        "embed": np.asarray(sd["transformer.wte.weight"]),
+        "pos_embed": np.asarray(sd["transformer.wpe.weight"]),
+        "final_norm": {
+            "scale": np.asarray(sd["transformer.ln_f.weight"]),
+            "bias": np.asarray(sd["transformer.ln_f.bias"]),
+        },
+        "layers": {
+            "attn": {
+                "wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+                "bq": _stack(bqs), "bk": _stack(bks), "bv": _stack(bvs),
+                "wo": _stack(g(i, "attn.c_proj.weight") for i in range(L)),
+                "bo": _stack(g(i, "attn.c_proj.bias") for i in range(L)),
+            },
+            "mlp": {
+                "wi": _stack(g(i, "mlp.c_fc.weight") for i in range(L)),
+                "bi": _stack(g(i, "mlp.c_fc.bias") for i in range(L)),
+                "wo": _stack(g(i, "mlp.c_proj.weight") for i in range(L)),
+                "bo": _stack(g(i, "mlp.c_proj.bias") for i in range(L)),
+            },
+            "ln1": {
+                "scale": _stack(g(i, "ln_1.weight") for i in range(L)),
+                "bias": _stack(g(i, "ln_1.bias") for i in range(L)),
+            },
+            "ln2": {
+                "scale": _stack(g(i, "ln_2.weight") for i in range(L)),
+                "bias": _stack(g(i, "ln_2.bias") for i in range(L)),
+            },
+        },
+    }
+
+
 CONVERTERS = {
     "mixtral": convert_mixtral,  # before "llama": shares its attention
+    "gemma": convert_gemma,      # likewise llama-keyed
+    "gpt2": convert_gpt2,
     "llama": convert_llama,
     "falcon": convert_falcon,
     "opt": convert_opt,

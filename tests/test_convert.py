@@ -110,3 +110,42 @@ def test_mixtral_parity():
         moe_num_experts=4, moe_top_k=2, moe_capacity_factor=8.0)
     tokens = np.random.default_rng(2).integers(0, 128, (2, 12))
     compare(cfg, hf, tokens)
+
+
+def test_gemma_parity():
+    """Gemma: llama keys + (1+w) RMSNorm + GeGLU + scaled tied embeddings."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    hf_cfg = GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        hidden_act="gelu_pytorch_tanh", tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = GemmaForCausalLM(hf_cfg)
+    cfg = ModelConfig(
+        name="gemma-test", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=1,
+        head_dim=16, max_seq_len=64, norm_eps=1e-6, activation="gelu",
+        tie_embeddings=True, embed_scale=True, dtype="float32")
+    tokens = np.random.default_rng(3).integers(0, 128, (2, 12))
+    compare(cfg, hf, tokens)
+
+
+def test_gpt2_parity():
+    """GPT-2: Conv1D (no transpose), fused qkv, learned positions."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        activation_function="gelu_new")
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(hf_cfg)
+    cfg = ModelConfig(
+        name="gpt2-test", vocab_size=128, hidden_size=64,
+        intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=4,
+        head_dim=16, max_seq_len=64, norm_type="layernorm", gated_mlp=False,
+        activation="gelu", position_type="learned", attn_bias=True,
+        mlp_bias=True, tie_embeddings=True, dtype="float32")
+    tokens = np.random.default_rng(4).integers(0, 128, (2, 10))
+    compare(cfg, hf, tokens)
