@@ -350,6 +350,14 @@ def cmd_serve(args) -> int:
         raise SystemExit(f"servers/{name} not found")
     if not wait_ready(client, obj, args.timeout):
         return 1
+    pod = _server_run_pod(client, args.namespace, name)
+    if pod is not None:
+        from runbooks_tpu.controller.server import SERVE_PORT
+
+        rc = _inprocess_port_forward(client, args.namespace, pod,
+                                     args.port, SERVE_PORT)
+        if rc is not None:
+            return rc
     print(f"forwarding localhost:{args.port} -> service/{name}:80 "
           f"(ctrl-c to stop)")
     return _kubectl_port_forward(f"service/{name}", args.port, 80,
@@ -403,6 +411,9 @@ def cmd_notebook(args) -> int:
 
         start_sync(pod, args.namespace, context_dir(args.filename))
     print(f"open http://localhost:8888?token=default")
+    rc = _inprocess_port_forward(client, args.namespace, pod, 8888, 8888)
+    if rc is not None:
+        return rc
     return _kubectl_port_forward(f"pod/{pod}", 8888, 8888, args.namespace)
 
 
@@ -442,6 +453,49 @@ def cmd_suspend(args) -> int:
                   "spec": {"suspend": True}}, "rbt-cli-suspend")
     print(f"notebooks/{name} suspended")
     return 0
+
+
+def _inprocess_port_forward(client, namespace: str, pod: str,
+                            local: int, remote: int) -> Optional[int]:
+    """Pod port-forward over the Kubernetes websocket subresource — no
+    kubectl needed (reference does the equivalent in-process over SPDY:
+    internal/client/port_forward.go). Returns an exit code, or None when
+    the client has no real KubeConfig (fake/demo mode) so the caller can
+    fall back to kubectl."""
+    cfg = getattr(client, "config", None)
+    if cfg is None:
+        return None
+    from runbooks_tpu.k8s.portforward import PortForwarder
+
+    pf = PortForwarder(
+        cfg, namespace, pod, local, remote,
+        on_ready=lambda p: print(
+            f"forwarding localhost:{p} -> {pod}:{remote} (ctrl-c to stop)"))
+    try:
+        pf.serve()
+    except KeyboardInterrupt:
+        return 0
+    except ConnectionError as e:
+        print(f"port-forward failed: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:  # e.g. local port already in use
+        print(f"port-forward could not listen on localhost:{local}: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        pf.stop()
+    return 0
+
+
+def _server_run_pod(client, namespace: str, name: str) -> Optional[str]:
+    """A running pod of a Server's deployment (labels server=name,
+    role=run) — the reference's serve flow watches for the same pod
+    (internal/tui/serve.go:203-228)."""
+    for pod in client.list("v1", "Pod", namespace=namespace,
+                           label_selector={"server": name, "role": "run"}):
+        if ko.deep_get(pod, "status", "phase", default="") == "Running":
+            return ko.name(pod)
+    return None
 
 
 def _kubectl_port_forward(target: str, local: int, remote: int,

@@ -1,0 +1,287 @@
+"""In-process pod port-forwarding over the Kubernetes websocket protocol.
+
+Reference analog: internal/client/port_forward.go (SPDY via client-go).
+Kubernetes serves the same subresource over websockets
+(`v4.channel.k8s.io`), which needs no SPDY stack: each websocket message is
+a 1-byte channel id + payload, with channels (2*i) = data and (2*i)+1 =
+errors for the i-th requested port; the first message on each channel
+carries the port number (uint16 LE). One websocket session == one TCP
+connection's worth of streams, so every accepted local connection dials a
+fresh session — exactly how kubectl's SPDY dialer behaves.
+
+The websocket client itself is stdlib-only (RFC 6455: handshake, masked
+client frames, ping/pong, fragmentation) — no external deps, same policy
+as the rest of k8s/.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import socket
+import ssl
+import struct
+import threading
+import urllib.parse
+from typing import Callable, Optional
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class WebSocket:
+    """Minimal RFC 6455 client over an established socket."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self._buf = b""
+        self._lock = threading.Lock()
+
+    # -- handshake ---------------------------------------------------------
+
+    @classmethod
+    def connect(cls, url: str, headers: dict, subprotocol: str,
+                ssl_ctx: Optional[ssl.SSLContext] = None,
+                timeout: float = 30.0) -> "WebSocket":
+        parts = urllib.parse.urlparse(url)
+        secure = parts.scheme in ("https", "wss")
+        port = parts.port or (443 if secure else 80)
+        raw = socket.create_connection((parts.hostname, port), timeout)
+        if secure:
+            ctx = ssl_ctx or ssl.create_default_context()
+            raw = ctx.wrap_socket(raw, server_hostname=parts.hostname)
+        key = base64.b64encode(os.urandom(16)).decode()
+        path = parts.path + (f"?{parts.query}" if parts.query else "")
+        req = [f"GET {path} HTTP/1.1",
+               f"Host: {parts.hostname}:{port}",
+               "Upgrade: websocket",
+               "Connection: Upgrade",
+               f"Sec-WebSocket-Key: {key}",
+               "Sec-WebSocket-Version: 13",
+               f"Sec-WebSocket-Protocol: {subprotocol}"]
+        req += [f"{k}: {v}" for k, v in headers.items()]
+        raw.sendall(("\r\n".join(req) + "\r\n\r\n").encode())
+
+        response = b""
+        while b"\r\n\r\n" not in response:
+            chunk = raw.recv(4096)
+            if not chunk:
+                raise ConnectionError("websocket handshake: connection closed")
+            response += chunk
+        head, _, rest = response.partition(b"\r\n\r\n")
+        status = head.split(b"\r\n", 1)[0]
+        if b"101" not in status:
+            raise ConnectionError(
+                f"websocket handshake rejected: {status.decode(errors='replace')}")
+        accept = hashlib.sha1((key + _WS_GUID).encode()).digest()
+        expect = base64.b64encode(accept).decode()
+        got = None
+        for line in head.decode(errors="replace").split("\r\n")[1:]:
+            name, _, value = line.partition(":")
+            if name.strip().lower() == "sec-websocket-accept":
+                got = value.strip()
+        if got != expect:
+            raise ConnectionError("websocket handshake: bad accept key")
+        ws = cls(raw)
+        ws._buf = rest
+        return ws
+
+    # -- frames ------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("websocket closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def send(self, payload: bytes, opcode: int = 0x2) -> None:
+        header = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            header += bytes([0x80 | n])
+        elif n < 1 << 16:
+            header += bytes([0x80 | 126]) + struct.pack(">H", n)
+        else:
+            header += bytes([0x80 | 127]) + struct.pack(">Q", n)
+        mask = os.urandom(4)
+        if n:
+            # Vectorized XOR: per-byte Python masking caps bulk-forwarding
+            # throughput (one interpreted op per byte).
+            import numpy as np
+
+            arr = np.frombuffer(payload, np.uint8)
+            tiled = np.frombuffer(mask * ((n + 3) // 4), np.uint8)[:n]
+            masked = (arr ^ tiled).tobytes()
+        else:
+            masked = b""
+        with self._lock:
+            self.sock.sendall(header + mask + masked)
+
+    def recv(self) -> Optional[bytes]:
+        """Next binary/text message payload; None on clean close.
+        Handles fragmentation and control frames inline."""
+        message = b""
+        while True:
+            b0, b1 = self._read_exact(2)
+            opcode, fin = b0 & 0x0F, b0 & 0x80
+            masked, n = b1 & 0x80, b1 & 0x7F
+            if n == 126:
+                n = struct.unpack(">H", self._read_exact(2))[0]
+            elif n == 127:
+                n = struct.unpack(">Q", self._read_exact(8))[0]
+            mask = self._read_exact(4) if masked else b""
+            payload = self._read_exact(n)
+            if mask and payload:  # servers send unmasked; rarely taken
+                import numpy as np
+
+                tiled = np.frombuffer(
+                    mask * ((len(payload) + 3) // 4), np.uint8)[:len(payload)]
+                payload = (np.frombuffer(payload, np.uint8) ^ tiled).tobytes()
+            if opcode == 0x8:                       # close
+                try:
+                    self.send(payload, opcode=0x8)
+                except OSError:
+                    pass
+                return None
+            if opcode == 0x9:                       # ping -> pong
+                self.send(payload, opcode=0xA)
+                continue
+            if opcode == 0xA:                       # pong
+                continue
+            message += payload
+            if fin:
+                return message
+
+    def close(self) -> None:
+        try:
+            self.send(b"", opcode=0x8)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PortForwarder:
+    """Forward localhost:local_port -> pod:remote_port, one websocket
+    session per accepted TCP connection."""
+
+    def __init__(self, config, namespace: str, pod: str,
+                 local_port: int, remote_port: int,
+                 on_ready: Optional[Callable[[int], None]] = None):
+        self.config = config            # k8s.client.KubeConfig
+        self.namespace = namespace
+        self.pod = pod
+        self.local_port = local_port
+        self.remote_port = remote_port
+        self.on_ready = on_ready
+        self._stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._error: Optional[BaseException] = None
+
+    def _fail(self, exc: BaseException) -> None:
+        """Record the first fatal error (from any connection thread) and
+        wind down serve() so callers actually see it."""
+        if self._error is None:
+            self._error = exc
+        self._stop.set()
+
+    def _ws_url(self) -> str:
+        server = self.config.server
+        return (f"{server}/api/v1/namespaces/{self.namespace}/pods/"
+                f"{self.pod}/portforward?ports={self.remote_port}")
+
+    def _dial(self) -> WebSocket:
+        ws = WebSocket.connect(
+            self._ws_url(), self.config.headers, "v4.channel.k8s.io",
+            ssl_ctx=(self.config.ssl_ctx
+                     if self.config.server.startswith("https") else None))
+        # First message per channel announces the port (uint16 LE).
+        for _ in range(2):
+            msg = ws.recv()
+            if msg is None or len(msg) < 3:
+                raise ConnectionError("port-forward: missing port header")
+            (port,) = struct.unpack("<H", msg[1:3])
+            if port != self.remote_port:
+                raise ConnectionError(
+                    f"port-forward: unexpected port {port}")
+        return ws
+
+    def _pump(self, conn: socket.socket) -> None:
+        try:
+            ws = self._dial()
+        except Exception as e:  # auth expiry, pod gone, apiserver down
+            conn.close()
+            self._fail(ConnectionError(f"port-forward dial failed: {e}"))
+            return
+
+        def local_to_ws():
+            try:
+                while not self._stop.is_set():
+                    data = conn.recv(65536)
+                    if not data:
+                        break
+                    ws.send(b"\x00" + data)   # channel 0 = data
+            except OSError:
+                pass
+            ws.close()
+
+        threading.Thread(target=local_to_ws, daemon=True).start()
+        try:
+            while not self._stop.is_set():
+                msg = ws.recv()
+                if msg is None or not msg:
+                    break
+                channel, payload = msg[0], msg[1:]
+                if channel == 0 and payload:
+                    conn.sendall(payload)
+                elif channel == 1 and payload:
+                    # Apiserver error event (e.g. "container not running"):
+                    # must surface, not vanish — ConnectionError is an
+                    # OSError subclass, so catch order matters below.
+                    self._fail(ConnectionError(
+                        "port-forward error: "
+                        f"{payload.decode(errors='replace')}"))
+                    break
+        except OSError:
+            pass
+        finally:
+            conn.close()
+            ws.close()
+
+    def serve(self) -> None:
+        """Listen and forward until stop(); calls on_ready(local_port) once
+        listening (the bound port — useful with local_port=0). Raises
+        ConnectionError on dial/auth failures or apiserver error events."""
+        # Preflight one session so bad auth/paths fail fast, before the
+        # caller is told the tunnel is ready.
+        try:
+            self._dial().close()
+        except Exception as e:
+            raise ConnectionError(
+                f"port-forward dial failed: {e}") from e
+        listener = socket.create_server(("127.0.0.1", self.local_port))
+        self._listener = listener
+        self.local_port = listener.getsockname()[1]
+        if self.on_ready is not None:
+            self.on_ready(self.local_port)
+        listener.settimeout(0.5)
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = listener.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(target=self._pump, args=(conn,),
+                                 daemon=True).start()
+        finally:
+            listener.close()
+        if self._error is not None:
+            raise ConnectionError(str(self._error))
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -180,7 +180,7 @@ class NotebookFlow(_BaseFlow):
                     pod, self.namespace, _build_context(None, self.path)))
             cmds.append(port_forward_cmd(
                 f"pod/{pod}", 8888, 8888, self.namespace,
-                runner=self.pf_runner))
+                runner=self.pf_runner, client=self.client, pod=pod))
         elif isinstance(msg, m.FileSync):
             self.current_sync_file = "" if msg.complete else msg.file
             self.last_sync_error = msg.error

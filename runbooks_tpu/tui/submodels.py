@@ -176,17 +176,33 @@ def delete_cmd(client, obj: dict):
 
 
 def port_forward_cmd(target: str, local: int, remote: int, namespace: str,
-                     runner: Optional[Callable] = None):
-    """kubectl port-forward with exponential backoff (reference:
-    portforward.go retry loop). `runner` is injectable for tests."""
+                     runner: Optional[Callable] = None,
+                     client=None, pod: Optional[str] = None):
+    """Port-forward with exponential backoff (reference: portforward.go
+    retry loop). Prefers the in-process websocket forwarder
+    (k8s/portforward.py) when a real KubeConfig + pod name are available;
+    kubectl shell-out otherwise. `runner` is injectable for tests (forces
+    the kubectl path)."""
     def default_runner(cmd_argv):
         import subprocess
         return subprocess.call(
             cmd_argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
-    run = runner or default_runner
+    cfg = getattr(client, "config", None) if client is not None else None
 
     def cmd(send):
+        if runner is None and cfg is not None and pod is not None:
+            from runbooks_tpu.k8s.portforward import PortForwarder
+
+            pf = PortForwarder(
+                cfg, namespace, pod, local, remote,
+                on_ready=lambda p: send(m.PortForwardReady(p, remote)))
+            try:
+                pf.serve()  # runs for the session on this command thread
+                return None
+            except ConnectionError as e:
+                return m.Error(RuntimeError(f"port-forward failed: {e}"))
+        run = runner or default_runner
         backoff = 1.0
         argv = ["kubectl", "port-forward", "-n", namespace, target,
                 f"{local}:{remote}"]
@@ -202,6 +218,8 @@ def port_forward_cmd(target: str, local: int, remote: int, namespace: str,
             time.sleep(backoff)
             backoff = min(backoff * 2, 30.0)
         return m.Error(RuntimeError(f"port-forward to {target} kept failing"))
+    # Not tagged long_running: with a test runner it returns promptly, and
+    # Program runs it on a daemon thread either way.
     return cmd
 
 

@@ -1,0 +1,219 @@
+"""In-process port-forward tests against a fake Kubernetes websocket
+endpoint (server side of v4.channel.k8s.io implemented on the stdlib, like
+the client). Covers: RFC6455 handshake + masking, the per-channel port
+headers, bidirectional data pumping, and the error channel."""
+
+import base64
+import hashlib
+import socket
+import ssl
+import struct
+import threading
+import time
+
+import pytest
+
+from runbooks_tpu.k8s.client import KubeConfig
+from runbooks_tpu.k8s.portforward import _WS_GUID, PortForwarder, WebSocket
+
+
+class FakeWsPodServer:
+    """Accepts the portforward websocket upgrade and echoes channel-0 data
+    uppercased; can emit an error-channel message instead."""
+
+    def __init__(self, remote_port: int, error: bytes = b""):
+        self.remote_port = remote_port
+        self.error = error
+        self.requests = []
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    # -- server-side frame helpers (unmasked) -----------------------------
+
+    @staticmethod
+    def _send(conn, payload, opcode=0x2):
+        header = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            header += bytes([n])
+        else:
+            header += bytes([126]) + struct.pack(">H", n)
+        conn.sendall(header + payload)
+
+    @staticmethod
+    def _recv(conn):
+        def read(n):
+            out = b""
+            while len(out) < n:
+                chunk = conn.recv(n - len(out))
+                if not chunk:
+                    raise ConnectionError
+                out += chunk
+            return out
+        b0, b1 = read(2)
+        opcode, n = b0 & 0x0F, b1 & 0x7F
+        if n == 126:
+            n = struct.unpack(">H", read(2))[0]
+        mask = read(4) if b1 & 0x80 else b""
+        payload = read(n)
+        if mask:
+            payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        return opcode, payload
+
+    def _serve(self):
+        self._srv.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed during shutdown
+            threading.Thread(target=self._session, args=(conn,),
+                             daemon=True).start()
+
+    def _session(self, conn):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            data += conn.recv(4096)
+        head = data.split(b"\r\n\r\n")[0].decode()
+        self.requests.append(head)
+        key = next(l.split(":", 1)[1].strip() for l in head.split("\r\n")
+                   if l.lower().startswith("sec-websocket-key"))
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_GUID).encode()).digest()).decode()
+        conn.sendall((
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {accept}\r\n"
+            "Sec-WebSocket-Protocol: v4.channel.k8s.io\r\n\r\n").encode())
+        # Port headers on data + error channels (uint16 LE).
+        self._send(conn, b"\x00" + struct.pack("<H", self.remote_port))
+        self._send(conn, b"\x01" + struct.pack("<H", self.remote_port))
+        if self.error:
+            self._send(conn, b"\x01" + self.error)
+            return
+        try:
+            while True:
+                opcode, payload = self._recv(conn)
+                if opcode == 0x8:
+                    return
+                if opcode == 0x2 and payload and payload[0] == 0:
+                    self._send(conn, b"\x00" + payload[1:].upper())
+        except ConnectionError:
+            pass
+
+    def close(self):
+        self._stop.set()
+        self._srv.close()
+
+
+def kubeconfig_for(server: FakeWsPodServer) -> KubeConfig:
+    return KubeConfig(f"http://127.0.0.1:{server.port}",
+                      ssl.create_default_context(),
+                      {"Authorization": "Bearer test-token"})
+
+
+def test_port_forward_roundtrip():
+    backend = FakeWsPodServer(remote_port=8080)
+    ready = threading.Event()
+    bound = {}
+
+    def on_ready(port):
+        bound["port"] = port
+        ready.set()
+
+    pf = PortForwarder(kubeconfig_for(backend), "ns1", "pod1",
+                       local_port=0, remote_port=8080, on_ready=on_ready)
+    threading.Thread(target=pf.serve, daemon=True).start()
+    assert ready.wait(timeout=5)
+
+    with socket.create_connection(("127.0.0.1", bound["port"]), 5) as c:
+        c.sendall(b"hello pod")
+        c.settimeout(5)
+        out = c.recv(1024)
+    assert out == b"HELLO POD"
+
+    # The wire request hit the right subresource with auth + subprotocol.
+    head = backend.requests[0]
+    assert "GET /api/v1/namespaces/ns1/pods/pod1/portforward?ports=8080" \
+        in head
+    assert "Authorization: Bearer test-token" in head
+    assert "v4.channel.k8s.io" in head
+
+    # A second connection dials a fresh websocket session (3 = the serve()
+    # preflight + one session per TCP connection).
+    with socket.create_connection(("127.0.0.1", bound["port"]), 5) as c:
+        c.sendall(b"x")
+        c.settimeout(5)
+        assert c.recv(64) == b"X"
+    assert len(backend.requests) == 3
+
+    pf.stop()
+    backend.close()
+
+
+def test_port_forward_error_channel_closes_connection():
+    backend = FakeWsPodServer(remote_port=9000,
+                              error=b"pod not running")
+    ready = threading.Event()
+    bound = {}
+    pf = PortForwarder(kubeconfig_for(backend), "ns1", "pod1",
+                       local_port=0, remote_port=9000,
+                       on_ready=lambda p: (bound.update(port=p),
+                                           ready.set()))
+    threading.Thread(target=pf.serve, daemon=True).start()
+    assert ready.wait(timeout=5)
+    with socket.create_connection(("127.0.0.1", bound["port"]), 5) as c:
+        c.settimeout(5)
+        assert c.recv(64) == b""  # closed after the error event
+    # The apiserver's message is captured, not swallowed (serve() raises).
+    deadline = time.time() + 5
+    while time.time() < deadline and pf._error is None:
+        time.sleep(0.05)
+    assert "pod not running" in str(pf._error)
+    backend.close()
+
+
+def test_port_forward_preflight_rejects_bad_auth():
+    """serve() fails fast (before on_ready) when the dial is rejected."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def reject_all():
+        srv.settimeout(2)
+        try:
+            while True:
+                conn, _ = srv.accept()
+                conn.recv(4096)
+                conn.sendall(b"HTTP/1.1 403 Forbidden\r\n\r\n")
+                conn.close()
+        except (socket.timeout, OSError):
+            pass
+
+    threading.Thread(target=reject_all, daemon=True).start()
+    cfg = KubeConfig(f"http://127.0.0.1:{port}",
+                     ssl.create_default_context(), {})
+    pf = PortForwarder(cfg, "ns", "pod", 0, 8080,
+                       on_ready=lambda p: pytest.fail("must not get ready"))
+    with pytest.raises(ConnectionError, match="403"):
+        pf.serve()
+    srv.close()
+
+
+def test_websocket_rejects_bad_handshake():
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def reject():
+        conn, _ = srv.accept()
+        conn.recv(4096)
+        conn.sendall(b"HTTP/1.1 403 Forbidden\r\n\r\n")
+        conn.close()
+
+    threading.Thread(target=reject, daemon=True).start()
+    with pytest.raises(ConnectionError, match="403"):
+        WebSocket.connect(f"http://127.0.0.1:{port}/x", {}, "proto")
+    srv.close()
