@@ -20,6 +20,7 @@ import os
 import re
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 from typing import List, Optional
@@ -417,6 +418,109 @@ def cmd_notebook(args) -> int:
     return _kubectl_port_forward(f"pod/{pod}", 8888, 8888, args.namespace)
 
 
+def _sse_chat_once(url: str, messages: List[dict], max_tokens: int,
+                   temperature: float, out=None) -> str:
+    """One streamed chat turn: POST /v1/chat/completions with stream:true,
+    print deltas as they arrive, return the full assistant text."""
+    out = out if out is not None else sys.stdout  # late-bound: tests capture
+    req = urllib.request.Request(
+        f"{url}/v1/chat/completions",
+        data=json.dumps({"messages": messages, "max_tokens": max_tokens,
+                         "temperature": temperature,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    text = []
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        for raw in resp:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            event = json.loads(payload)
+            if "error" in event:
+                raise RuntimeError(event["error"].get("message", "error"))
+            delta = event["choices"][0].get("delta", {})
+            piece = delta.get("content", "")
+            if piece:
+                text.append(piece)
+                out.write(piece)
+                out.flush()
+    out.write("\n")
+    return "".join(text)
+
+
+def cmd_chat(args) -> int:
+    """Interactive streaming chat against a Server (reference analog:
+    internal/tui/infer_chat.go — an unused skeleton there; functional
+    here). Resolves the server's running pod and opens an in-process
+    port-forward unless --url points somewhere directly."""
+    url = args.url
+    pf = None
+    if not url:
+        client = make_client(args)
+        kind, name = parse_scope(args.scope)
+        if kind != "Server" or not name:
+            raise SystemExit("usage: rbt chat servers/<name> | --url URL")
+        obj = client.get(API_VERSION, "Server", args.namespace, name)
+        if obj is None:
+            raise SystemExit(f"servers/{name} not found")
+        if not wait_ready(client, obj, args.timeout):
+            return 1
+        pod = _server_run_pod(client, args.namespace, name)
+        cfg = getattr(client, "config", None)
+        if pod is None or cfg is None:
+            raise SystemExit(
+                "no running server pod reachable; use --url with an "
+                "existing port-forward")
+        from runbooks_tpu.controller.server import SERVE_PORT
+        from runbooks_tpu.k8s.portforward import PortForwarder
+
+        ready = threading.Event()
+        bound = {}
+
+        def on_ready(p):
+            bound["port"] = p
+            ready.set()
+
+        pf = PortForwarder(cfg, args.namespace, pod, 0, SERVE_PORT,
+                           on_ready=on_ready)
+        threading.Thread(target=pf.serve, daemon=True).start()
+        if not ready.wait(timeout=30):
+            raise SystemExit("port-forward did not become ready")
+        url = f"http://127.0.0.1:{bound['port']}"
+
+    messages: List[dict] = []
+    if args.system:
+        messages.append({"role": "system", "content": args.system})
+    try:
+        while True:
+            try:
+                prompt = input("> ")
+            except EOFError:
+                break
+            if not prompt.strip():
+                continue
+            if prompt.strip() in ("/quit", "/exit"):
+                break
+            messages.append({"role": "user", "content": prompt})
+            try:
+                reply = _sse_chat_once(url, messages, args.max_tokens,
+                                       args.temperature)
+            except (RuntimeError, OSError) as e:
+                print(f"chat error: {e}", file=sys.stderr)
+                messages.pop()
+                continue
+            messages.append({"role": "assistant", "content": reply})
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if pf is not None:
+            pf.stop()
+    return 0
+
+
 def cmd_logs(args) -> int:
     """Stream logs of an object's workload pods (the reference TUI streams
     these inline — internal/tui/pods.go; here it shells to kubectl with the
@@ -573,6 +677,15 @@ def build_parser() -> argparse.ArgumentParser:
     common(sp)
     sp.add_argument("--no-sync", dest="sync", action="store_false")
     sp.set_defaults(func=cmd_notebook)
+
+    sp = sub.add_parser("chat", help="interactive chat with a Server")
+    sp.add_argument("scope", nargs="?", default="")
+    sp.add_argument("--url", help="server URL (skips port-forward)")
+    sp.add_argument("--system", help="system prompt")
+    sp.add_argument("--max-tokens", type=int, default=256)
+    sp.add_argument("--temperature", type=float, default=0.7)
+    sp.add_argument("--timeout", type=float, default=720.0)
+    sp.set_defaults(func=cmd_chat)
 
     sp = sub.add_parser("logs", help="stream workload pod logs")
     sp.add_argument("scope")

@@ -128,11 +128,11 @@ def test_port_forward_roundtrip():
     pf = PortForwarder(kubeconfig_for(backend), "ns1", "pod1",
                        local_port=0, remote_port=8080, on_ready=on_ready)
     threading.Thread(target=pf.serve, daemon=True).start()
-    assert ready.wait(timeout=5)
+    assert ready.wait(timeout=30)
 
-    with socket.create_connection(("127.0.0.1", bound["port"]), 5) as c:
+    with socket.create_connection(("127.0.0.1", bound["port"]), 30) as c:
         c.sendall(b"hello pod")
-        c.settimeout(5)
+        c.settimeout(30)
         out = c.recv(1024)
     assert out == b"HELLO POD"
 
@@ -145,9 +145,9 @@ def test_port_forward_roundtrip():
 
     # A second connection dials a fresh websocket session (3 = the serve()
     # preflight + one session per TCP connection).
-    with socket.create_connection(("127.0.0.1", bound["port"]), 5) as c:
+    with socket.create_connection(("127.0.0.1", bound["port"]), 30) as c:
         c.sendall(b"x")
-        c.settimeout(5)
+        c.settimeout(30)
         assert c.recv(64) == b"X"
     assert len(backend.requests) == 3
 
@@ -165,12 +165,12 @@ def test_port_forward_error_channel_closes_connection():
                        on_ready=lambda p: (bound.update(port=p),
                                            ready.set()))
     threading.Thread(target=pf.serve, daemon=True).start()
-    assert ready.wait(timeout=5)
-    with socket.create_connection(("127.0.0.1", bound["port"]), 5) as c:
-        c.settimeout(5)
+    assert ready.wait(timeout=30)
+    with socket.create_connection(("127.0.0.1", bound["port"]), 30) as c:
+        c.settimeout(30)
         assert c.recv(64) == b""  # closed after the error event
     # The apiserver's message is captured, not swallowed (serve() raises).
-    deadline = time.time() + 5
+    deadline = time.time() + 30
     while time.time() < deadline and pf._error is None:
         time.sleep(0.05)
     assert "pod not running" in str(pf._error)
