@@ -438,10 +438,19 @@ def _sse_chat_once(url: str, messages: List[dict], max_tokens: int,
             payload = line[len("data: "):]
             if payload == "[DONE]":
                 break
-            event = json.loads(payload)
+            # Tolerate schema drift from arbitrary --url endpoints
+            # (usage-only chunks with empty choices, non-JSON keepalives):
+            # skip what we can't read; only explicit error events abort.
+            try:
+                event = json.loads(payload)
+            except json.JSONDecodeError:
+                continue
             if "error" in event:
                 raise RuntimeError(event["error"].get("message", "error"))
-            delta = event["choices"][0].get("delta", {})
+            choices = event.get("choices") or []
+            if not choices:
+                continue
+            delta = choices[0].get("delta", {})
             piece = delta.get("content", "")
             if piece:
                 text.append(piece)

@@ -172,24 +172,23 @@ def test_chat_streams_against_live_server(monkeypatch, capsys):
     cfg = get_config("debug", dtype="float32")
     app = create_server(cfg, init_params(cfg, jax.random.key(0)),
                         max_slots=2)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-
     started = threading.Event()
+    bound = {}
 
     def run_app():
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
-        loop.run_until_complete(
-            web.TCPSite(runner, "127.0.0.1", port).start())
+        site = web.TCPSite(runner, "127.0.0.1", 0)  # OS-assigned: no TOCTOU
+        loop.run_until_complete(site.start())
+        bound["port"] = site._server.sockets[0].getsockname()[1]
         started.set()
         loop.run_forever()
 
     threading.Thread(target=run_app, daemon=True).start()
     assert started.wait(timeout=30)
+    port = bound["port"]
 
     lines = iter(["hello there", "/quit"])
     monkeypatch.setattr("builtins.input",
