@@ -369,12 +369,29 @@ def cmd_notebook(args) -> int:
     """Apply/derive a Notebook, upload the workspace, wait, port-forward 8888,
     and sync files back (reference: internal/tui/notebook.go flow)."""
     client = make_client(args)
+    if args.resume and args.build:
+        raise SystemExit(
+            "--resume reattaches without uploading; drop --build (apply the "
+            "manifest again to rebuild)")
     if use_tui(args):
         from runbooks_tpu.tui.flows import NotebookFlow
 
         return run_flow(NotebookFlow(
             client, args.filename, args.namespace, build_dir=args.build,
-            sync=args.sync, timeout_s=args.timeout))
+            sync=args.sync, timeout_s=args.timeout, resume=args.resume))
+    if args.resume:
+        # Reattach to an existing notebook: no manifests, no upload — just
+        # unsuspend if needed, then the shared wait/sync/port-forward tail
+        # (reference: `sub notebook --resume <name>`).
+        nb = client.get(API_VERSION, "Notebook", args.namespace, args.resume)
+        if nb is None:
+            raise SystemExit(f"notebooks/{args.resume} not found")
+        if ko.deep_get(nb, "spec", "suspend"):
+            client.apply({"apiVersion": API_VERSION, "kind": "Notebook",
+                          "metadata": {"name": args.resume,
+                                       "namespace": args.namespace},
+                          "spec": {"suspend": False}}, "rbt-cli-suspend")
+        return _notebook_attach(client, args, nb)
     manifests = load_manifests(args.filename, args.namespace)
     nb = next((m for m in manifests if m["kind"] == "Notebook"), None)
     if nb is None and manifests:
@@ -404,6 +421,12 @@ def cmd_notebook(args) -> int:
         nb["spec"]["suspend"] = False
         client.apply(nb, "rbt-cli")
     print(f"notebooks/{ko.name(nb)} applied; waiting for readiness…")
+    return _notebook_attach(client, args, nb)
+
+
+def _notebook_attach(client, args, nb: dict) -> int:
+    """Shared notebook tail: wait ready, start file sync, port-forward
+    8888 (used by both the fresh-apply and --resume paths)."""
     if not wait_ready(client, nb, args.timeout):
         return 1
     pod = f"{ko.name(nb)}-notebook"
@@ -411,7 +434,7 @@ def cmd_notebook(args) -> int:
         from runbooks_tpu.utils.sync import start_sync
 
         start_sync(pod, args.namespace, context_dir(args.filename))
-    print(f"open http://localhost:8888?token=default")
+    print("open http://localhost:8888?token=default")
     rc = _inprocess_port_forward(client, args.namespace, pod, 8888, 8888)
     if rc is not None:
         return rc
@@ -685,6 +708,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("notebook", help="notebook dev loop")
     common(sp)
     sp.add_argument("--no-sync", dest="sync", action="store_false")
+    sp.add_argument("-r", "--resume", metavar="NAME",
+                    help="reattach to an existing notebook (no upload)")
     sp.set_defaults(func=cmd_notebook)
 
     sp = sub.add_parser("chat", help="interactive chat with a Server")

@@ -99,7 +99,8 @@ class NotebookFlow(_BaseFlow):
 
     def __init__(self, client, path: str, namespace: str,
                  build_dir: Optional[str] = None, sync: bool = True,
-                 timeout_s: float = 720.0, pf_runner=None):
+                 timeout_s: float = 720.0, resume: Optional[str] = None,
+                 pf_runner=None):
         super().__init__()
         self.client = client
         self.path = path
@@ -107,6 +108,7 @@ class NotebookFlow(_BaseFlow):
         self.build_dir = build_dir
         self.sync = sync
         self.timeout_s = timeout_s
+        self.resume = resume  # reattach to this notebook: no upload
         self.pf_runner = pf_runner  # injectable for tests
         self.manifests = ManifestsModel(path)
         self.upload = UploadModel()
@@ -120,6 +122,22 @@ class NotebookFlow(_BaseFlow):
         self.quitting = False
 
     def init(self, program=None) -> list:
+        if self.resume:
+            client, ns, name = self.client, self.namespace, self.resume
+
+            def fetch(send):
+                nb = client.get(API_VERSION, "Notebook", ns, name)
+                if nb is None:
+                    return m.Error(RuntimeError(
+                        f"notebooks/{name} not found"))
+                if ko.deep_get(nb, "spec", "suspend"):
+                    client.apply(
+                        {"apiVersion": API_VERSION, "kind": "Notebook",
+                         "metadata": {"name": name, "namespace": ns},
+                         "spec": {"suspend": False}}, "rbt-cli-suspend")
+                    nb = client.get(API_VERSION, "Notebook", ns, name)
+                return m.Applied(nb)
+            return [fetch]
         return [load_manifests_cmd(self.path, self.namespace,
                                    kinds=["Notebook", "Model", "Dataset"])]
 

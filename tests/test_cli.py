@@ -200,3 +200,46 @@ def test_chat_streams_against_live_server(monkeypatch, capsys):
     # Something streamed back (byte tokenizer output is arbitrary text,
     # so assert non-empty reply rather than specific content).
     assert len(out.strip()) > 0
+
+
+def test_notebook_resume_reattaches_without_upload(monkeypatch):
+    """`rbt notebook --resume NAME`: unsuspends, waits for the controller
+    to bring the pod back (suspended notebooks are NOT ready), then
+    port-forwards — no manifests or upload involved (reference:
+    sub notebook --resume)."""
+    import runbooks_tpu.cli.main as cli
+
+    client = FakeCluster()
+    client.create({"apiVersion": API_VERSION, "kind": "Notebook",
+                   "metadata": {"name": "nb1", "namespace": "default"},
+                   "spec": {"image": "img", "suspend": True},
+                   "status": {"ready": False}})
+    monkeypatch.setattr(cli, "make_client", lambda args: client)
+    forwarded = {}
+    monkeypatch.setattr(
+        cli, "_kubectl_port_forward",
+        lambda target, local, remote, ns: forwarded.update(
+            target=target, local=local) or 0)
+
+    def controller():  # readiness only AFTER the unsuspend lands
+        for _ in range(200):
+            nb = client.get(API_VERSION, "Notebook", "default", "nb1")
+            if nb["spec"].get("suspend") is False:
+                nb.setdefault("status", {})["ready"] = True
+                client.update_status(nb)
+                return
+            time.sleep(0.02)
+
+    threading.Thread(target=controller, daemon=True).start()
+    rc = cli.main(["notebook", "--resume", "nb1", "--no-sync",
+                   "--timeout", "10"])
+    assert rc == 0
+    nb = client.get(API_VERSION, "Notebook", "default", "nb1")
+    assert nb["spec"]["suspend"] is False  # unsuspended on resume
+    assert forwarded["target"] == "pod/nb1-notebook"
+
+    # Unknown name fails cleanly; --build conflicts loudly.
+    with pytest.raises(SystemExit, match="not found"):
+        cli.main(["notebook", "--resume", "ghost"])
+    with pytest.raises(SystemExit, match="drop --build"):
+        cli.main(["notebook", "--resume", "nb1", "--build", "."])

@@ -321,3 +321,25 @@ def test_flow_error_message_renders():
     flow = GetFlow(FakeCluster(), "default")
     feed(flow, m.Error(RuntimeError("boom")))
     assert "Error: boom" in strip_ansi(flow.view())
+
+
+def test_notebook_flow_resume_skips_upload():
+    """resume mode: init fetches + unsuspends the existing notebook and
+    goes straight to readiness (no manifests, no upload)."""
+    fake = FakeCluster()
+    nb = notebook_obj()
+    nb["spec"]["suspend"] = True
+    fake.create(nb)
+    flow = NotebookFlow(fake, ".", "default", sync=False, resume="nb1",
+                        pf_runner=lambda argv: 0)
+    msgs = run_cmds(flow, flow.init())
+    assert any(isinstance(x, m.Applied) for x in msgs)
+    assert not any(isinstance(x, m.ManifestsLoaded) for x in msgs)
+    cur = fake.get(API_VERSION, "Notebook", "default", "nb1")
+    assert cur["spec"]["suspend"] is False
+    assert flow.notebook is not None
+
+    # Missing notebook surfaces an error.
+    flow2 = NotebookFlow(fake, ".", "default", resume="ghost")
+    msgs = run_cmds(flow2, flow2.init())
+    assert any(isinstance(x, m.Error) for x in msgs)
