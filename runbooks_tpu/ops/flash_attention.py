@@ -15,8 +15,11 @@ Design (see /opt/skills/guides/pallas_guide.md):
 - GQA-native: k/v stay at kv_heads width; the BlockSpec index map routes
   q head hi to kv head hi // n_rep, so no repeated k/v is ever materialized.
 
-On non-TPU backends the kernels run in interpreter mode (tests); use
-``attention_impl="xla"`` (the default) where Mosaic is unavailable.
+On non-TPU backends the kernels run in interpreter mode (tests). The
+default ``attention_impl="auto"`` picks this kernel on TPU and the XLA
+reference path elsewhere; causal block skipping (above-diagonal blocks
+never DMA'd or computed) is on by default and exact for globally monotone
+position layouts.
 """
 
 from __future__ import annotations
@@ -34,6 +37,26 @@ PAD_POS = 2 ** 30  # kv-position sentinel for padding; always masked
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
+# Mosaic requires the last two dims of every block to be (multiples of the
+# (8, 128) tile) or equal to the array dims. Row metadata (positions/segment
+# ids) and per-row residuals (lse, delta) are therefore carried in
+# tile-friendly layouts, the same convention as the reference TPU kernels in
+# jax.experimental.pallas.ops.tpu.flash_attention: q-side rows broadcast
+# across LANES ([b, sq, 128], block [1, bq, 128]), kv-side rows broadcast
+# across SUBLANES ([b, 8, sk], block [1, 8, bk]), lse/delta stored
+# lane-broadcast ([b, h, sq, 128]).
+LANES = 128
+SUBLANES = 8
+
+
+def _bcast_lanes(x):  # [b, s] -> [b, s, LANES]
+    return jax.lax.broadcast_in_dim(x, (*x.shape, LANES), (0, 1))
+
+
+def _bcast_sublanes(x):  # [b, s] -> [b, SUBLANES, s]
+    return jax.lax.broadcast_in_dim(x, (x.shape[0], SUBLANES, x.shape[1]),
+                                    (0, 2))
+
 
 def _interpret() -> bool:
     # Compile via Mosaic only on real TPU backends (PJRT plugin backends may
@@ -50,64 +73,80 @@ def _interpret() -> bool:
 # Forward kernel
 # ---------------------------------------------------------------------------
 
+def _last_valid_kv(qi, block_q: int, block_k: int, num_kv):
+    """Last kv-block index that can contain an unmasked key for q block qi,
+    under causal masking with globally monotone positions (standard training
+    layout, including contiguous packing: a later global index is either a
+    future position or a later segment — masked either way)."""
+    return jnp.minimum(num_kv - 1, ((qi + 1) * block_q - 1) // block_k)
+
+
 def _fwd_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,  # prefetch-ish
                 q_ref, k_ref, v_ref,
                 o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, use_segments: bool):
+                *, scale: float, causal: bool, use_segments: bool,
+                block_q: int, block_k: int, block_skip: bool):
     kv_idx = pl.program_id(3)
     num_kv = pl.num_programs(3)
+    if block_skip and causal:
+        last_kv = _last_valid_kv(pl.program_id(2), block_q, block_k, num_kv)
+    else:
+        last_kv = num_kv - 1
 
-    @pl.when(kv_idx == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+    @pl.when(kv_idx <= last_kv)
+    def _body():
+        @pl.when(kv_idx == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
-    k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
-    v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bq, bk]
 
-    kp = kv_pos_ref[0][None, :]                           # [1, bk]
-    mask = kp < PAD_POS  # padding keys are masked regardless of causality
-    mask = jnp.broadcast_to(mask, s.shape)
-    if causal:
-        qp = q_pos_ref[0][:, None]                        # [bq, 1]
-        mask = jnp.logical_and(mask, kp <= qp)
-    if use_segments:
-        qs = q_seg_ref[0][:, None]
-        ks = kv_seg_ref[0][None, :]
-        mask = jnp.logical_and(mask, qs == ks)
-        mask = jnp.logical_and(mask, ks != 0)
-    s = jnp.where(mask, s, NEG_INF)
+        kp = kv_pos_ref[0][:1, :]                             # [1, bk]
+        mask = kp < PAD_POS  # padding keys masked regardless of causality
+        mask = jnp.broadcast_to(mask, s.shape)
+        if causal:
+            qp = q_pos_ref[0][:, :1]                          # [bq, 1]
+            mask = jnp.logical_and(mask, kp <= qp)
+        if use_segments:
+            qs = q_seg_ref[0][:, :1]
+            ks = kv_seg_ref[0][:1, :]
+            mask = jnp.logical_and(mask, qs == ks)
+            mask = jnp.logical_and(mask, ks != 0)
+        s = jnp.where(mask, s, NEG_INF)
 
-    m_prev = m_scr[:]                                     # [bq, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    # Rows with no valid key yet keep m == NEG_INF; guard the exp shift.
-    m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
-    p = jnp.exp(s - m_safe)
-    p = jnp.where(mask, p, 0.0)
+        m_prev = m_scr[:]                                     # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Rows with no valid key yet keep m == NEG_INF; guard the exp shift.
+        m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(mask, p, 0.0)
 
-    alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
-    l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
-    l_scr[:] = l_new
+        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
 
-    @pl.when(kv_idx == num_kv - 1)
-    def _finalize():
-        l = l_scr[:]
-        l_safe = jnp.where(l == 0.0, 1.0, l)              # fully-masked rows
-        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        m = m_scr[:]
-        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
-        lse_ref[0, 0] = lse[:, 0]
+        @pl.when(kv_idx == last_kv)
+        def _finalize():
+            l = l_scr[:]
+            l_safe = jnp.where(l == 0.0, 1.0, l)          # fully-masked rows
+            o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+            m = m_scr[:]
+            lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))  # [bq,1]
+            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _pad_to(x, size, axis, value=0):
@@ -120,7 +159,7 @@ def _pad_to(x, size, axis, value=0):
 
 
 def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
-               block_q, block_k):
+               block_q, block_k, block_skip=True):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kv_h = k.shape[2]
@@ -148,42 +187,57 @@ def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
         kv_seg_p = jnp.zeros_like(kv_pos_p)
 
     grid = (b, h, sq_p // block_q, sk_p // block_k)
+    # Grid-index skip is only exact when q index i and kv index i carry the
+    # same global position; unequal lengths guarantee misalignment.
+    skip = bool(block_skip and causal and sq == sk)
+    num_kv = sk_p // block_k
+
+    def clamp_k(qi, ki):
+        # Causal block skip: iterations past the diagonal re-point at the
+        # last valid block — same index as the previous iteration, so Pallas
+        # issues no DMA, and pl.when skips the compute.
+        if skip:
+            return jnp.minimum(ki, _last_valid_kv(qi, block_q, block_k,
+                                                  num_kv))
+        return ki
 
     def q_map(bi, hi, qi, ki):
         return (bi, hi, qi, 0)
 
     def kv_map(bi, hi, qi, ki):
         # GQA: q head hi reads kv head hi // n_rep — no repeated HBM copy.
-        return (bi, hi // n_rep, ki, 0)
+        return (bi, hi // n_rep, clamp_k(qi, ki), 0)
 
     def qrow_map(bi, hi, qi, ki):
-        return (bi, qi)
+        return (bi, qi, 0)
 
     def krow_map(bi, hi, qi, ki):
-        return (bi, ki)
+        return (bi, 0, clamp_k(qi, ki))
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, use_segments=use_segments)
+        _fwd_kernel, scale=scale, causal=causal, use_segments=use_segments,
+        block_q=block_q, block_k=block_k, block_skip=skip)
 
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q), qrow_map),                 # q_pos
-            pl.BlockSpec((1, block_k), krow_map),                 # kv_pos
-            pl.BlockSpec((1, block_q), qrow_map),                 # q_seg
-            pl.BlockSpec((1, block_k), krow_map),                 # kv_seg
+            pl.BlockSpec((1, block_q, LANES), qrow_map),          # q_pos
+            pl.BlockSpec((1, SUBLANES, block_k), krow_map),       # kv_pos
+            pl.BlockSpec((1, block_q, LANES), qrow_map),          # q_seg
+            pl.BlockSpec((1, SUBLANES, block_k), krow_map),       # kv_seg
             pl.BlockSpec((1, 1, block_q, d), q_map),              # q
             pl.BlockSpec((1, 1, block_k, d), kv_map),             # k
             pl.BlockSpec((1, 1, block_k, d), kv_map),             # v
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), q_map),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq_p, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -191,10 +245,11 @@ def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q_pos_p, kv_pos_p, q_seg_p, kv_seg_p, qT, kT, vT)
+    )(_bcast_lanes(q_pos_p), _bcast_sublanes(kv_pos_p),
+      _bcast_lanes(q_seg_p), _bcast_sublanes(kv_seg_p), qT, kT, vT)
 
     out = jnp.swapaxes(out[:, :, :sq], 1, 2)          # [b, sq, h, d]
-    return out, lse[:, :, :sq]
+    return out, lse[:, :, :sq, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -204,96 +259,118 @@ def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
 def _bwd_dq_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,
                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr,
-                   *, scale, causal, use_segments):
+                   *, scale, causal, use_segments,
+                   block_q, block_k, block_skip):
     kv_idx = pl.program_id(3)
     num_kv = pl.num_programs(3)
+    if block_skip and causal:
+        last_kv = _last_valid_kv(pl.program_id(2), block_q, block_k, num_kv)
+    else:
+        last_kv = num_kv - 1
 
-    @pl.when(kv_idx == 0)
-    def _init():
-        dq_scr[:] = jnp.zeros_like(dq_scr)
+    @pl.when(kv_idx <= last_kv)
+    def _body():
+        @pl.when(kv_idx == 0)
+        def _init():
+            dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]                              # [bq, 1]
-    delta = delta_ref[0, 0][:, None]                          # [bq, 1]
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]                            # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]                        # [bq, 1]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = jnp.broadcast_to(kv_pos_ref[0][None, :] < PAD_POS, s.shape)
-    if causal:
-        mask = jnp.logical_and(mask,
-                               kv_pos_ref[0][None, :] <= q_pos_ref[0][:, None])
-    if use_segments:
-        mask = jnp.logical_and(mask,
-                               q_seg_ref[0][:, None] == kv_seg_ref[0][None, :])
-        mask = jnp.logical_and(mask, kv_seg_ref[0][None, :] != 0)
-    lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
-    p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = jnp.broadcast_to(kv_pos_ref[0][:1, :] < PAD_POS, s.shape)
+        if causal:
+            mask = jnp.logical_and(
+                mask, kv_pos_ref[0][:1, :] <= q_pos_ref[0][:, :1])
+        if use_segments:
+            mask = jnp.logical_and(
+                mask, q_seg_ref[0][:, :1] == kv_seg_ref[0][:1, :])
+            mask = jnp.logical_and(mask, kv_seg_ref[0][:1, :] != 0)
+        lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
+        p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
 
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
-    dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
 
-    @pl.when(kv_idx == num_kv - 1)
-    def _finalize():
-        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+        @pl.when(kv_idx == last_kv)
+        def _finalize():
+            dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _first_valid_q(ki, block_q: int, block_k: int, num_q):
+    """First q-block index that can see any key in kv block ki (causal,
+    globally monotone positions) — the mirror of _last_valid_kv. Clamped to
+    num_q-1 so kv blocks entirely past the last q row (sk > sq) still run
+    one fully-masked iteration and write true zeros to dk/dv."""
+    return jnp.minimum(num_q - 1, (ki * block_k) // block_q)
 
 
 def _bwd_dkv_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,
                     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, use_segments):
+                    *, scale, causal, use_segments,
+                    block_q, block_k, block_skip):
     q_idx = pl.program_id(3)
     num_q = pl.num_programs(3)
+    if block_skip and causal:
+        first_q = _first_valid_q(pl.program_id(2), block_q, block_k, num_q)
+    else:
+        first_q = 0
 
-    @pl.when(q_idx == 0)
-    def _init():
-        dk_scr[:] = jnp.zeros_like(dk_scr)
-        dv_scr[:] = jnp.zeros_like(dv_scr)
+    @pl.when(q_idx >= first_q)
+    def _body():
+        @pl.when(q_idx == first_q)
+        def _init():
+            dk_scr[:] = jnp.zeros_like(dk_scr)
+            dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = jnp.broadcast_to(kv_pos_ref[0][None, :] < PAD_POS, s.shape)
-    if causal:
-        mask = jnp.logical_and(mask,
-                               kv_pos_ref[0][None, :] <= q_pos_ref[0][:, None])
-    if use_segments:
-        mask = jnp.logical_and(mask,
-                               q_seg_ref[0][:, None] == kv_seg_ref[0][None, :])
-        mask = jnp.logical_and(mask, kv_seg_ref[0][None, :] != 0)
-    lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
-    p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)        # [bq, bk]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = jnp.broadcast_to(kv_pos_ref[0][:1, :] < PAD_POS, s.shape)
+        if causal:
+            mask = jnp.logical_and(
+                mask, kv_pos_ref[0][:1, :] <= q_pos_ref[0][:, :1])
+        if use_segments:
+            mask = jnp.logical_and(
+                mask, q_seg_ref[0][:, :1] == kv_seg_ref[0][:1, :])
+            mask = jnp.logical_and(mask, kv_seg_ref[0][:1, :] != 0)
+        lse_safe = jnp.where(lse <= NEG_INF, 0.0, lse)
+        p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)        # [bq, bk]
 
-    dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale                          # [bq, bk]
-    dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                          # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
 
-    @pl.when(q_idx == num_q - 1)
-    def _finalize():
-        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+        @pl.when(q_idx == num_q - 1)
+        def _finalize():
+            dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+            dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
 # Public op with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
 def flash_attention(
     q: jax.Array,                      # [b, sq, h, d]
     k: jax.Array,                      # [b, sk, kv_h, d] (kv_h divides h)
@@ -306,23 +383,31 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    block_skip: bool = True,
 ) -> jax.Array:
+    """block_skip skips above-diagonal blocks by GRID index; it is exact
+    iff q storage index i holds the same global position as kv storage
+    index i (q_positions[:, i] == kv_positions[:, i] — standard training
+    layout, including contiguous packing). Offset layouts (e.g. a chunked
+    prefill where q rows start at position P > 0) violate this; the skip
+    auto-disables when sq != sk, and callers with aligned lengths but
+    misaligned positions must pass block_skip=False."""
     out, _ = _flash_fwd(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
         scale if scale is not None else q.shape[-1] ** -0.5, causal,
-        block_q, block_k)
+        block_q, block_k, block_skip)
     return out
 
 
 def _vjp_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg,
-             causal, scale, block_q, block_k):
+             causal, scale, block_q, block_k, block_skip):
     scale_v = scale if scale is not None else q.shape[-1] ** -0.5
     out, lse = _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg,
-                          scale_v, causal, block_q, block_k)
+                          scale_v, causal, block_q, block_k, block_skip)
     return out, (q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse)
 
 
-def _vjp_bwd(causal, scale, block_q, block_k, res, g):
+def _vjp_bwd(causal, scale, block_q, block_k, block_skip, res, g):
     q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse = res
     scale_v = scale if scale is not None else q.shape[-1] ** -0.5
     b, sq, h, d = q.shape
@@ -336,8 +421,14 @@ def _vjp_bwd(causal, scale, block_q, block_k, res, g):
 
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                # [b, sq, h]
-    deltaT = _pad_to(jnp.swapaxes(delta, 1, 2), sq_p, 2)     # [b, h, sq_p]
-    lseT = _pad_to(lse, sq_p, 2, value=NEG_INF)
+    # lse/delta are per-q-row; carried lane-broadcast [b, h, sq_p, LANES]
+    # to satisfy Mosaic block tiling (see layout note at top of file).
+    deltaT = jax.lax.broadcast_in_dim(
+        _pad_to(jnp.swapaxes(delta, 1, 2), sq_p, 2),
+        (b, h, sq_p, LANES), (0, 1, 2))
+    lseT = jax.lax.broadcast_in_dim(
+        _pad_to(lse, sq_p, 2, value=NEG_INF),
+        (b, h, sq_p, LANES), (0, 1, 2))
     qT = _pad_to(jnp.swapaxes(q, 1, 2), sq_p, 2)
     kT = _pad_to(jnp.swapaxes(k, 1, 2), sk_p, 2)
     vT = _pad_to(jnp.swapaxes(v, 1, 2), sk_p, 2)
@@ -352,47 +443,67 @@ def _vjp_bwd(causal, scale, block_q, block_k, res, g):
         q_seg_p = jnp.zeros_like(q_pos_p)
         kv_seg_p = jnp.zeros_like(kv_pos_p)
 
+    q_pos_l = _bcast_lanes(q_pos_p)
+    kv_pos_s = _bcast_sublanes(kv_pos_p)
+    q_seg_l = _bcast_lanes(q_seg_p)
+    kv_seg_s = _bcast_sublanes(kv_seg_p)
+
+    skip = bool(block_skip and causal and sq == sk)  # see _flash_fwd note
+    num_kv = sk_p // block_k
+    num_q = sq_p // block_q
+
+    def clamp_k(i, j):  # dq pass: kv block j valid only up to the diagonal
+        if skip:
+            return jnp.minimum(j, _last_valid_kv(i, block_q, block_k, num_kv))
+        return j
+
+    def clamp_q(j, i):  # dkv pass: q block i valid only from the diagonal on
+        if skip:
+            return jnp.maximum(i, _first_valid_q(j, block_q, block_k, num_q))
+        return i
+
     def qrow(bi, hi, i, j):
-        return (bi, i)
+        return (bi, i, 0)
 
     def krow(bi, hi, i, j):
-        return (bi, j)
+        return (bi, 0, clamp_k(i, j))
 
     def hq(bi, hi, i, j):
         return (bi, hi, i, 0)
 
     def hk(bi, hi, i, j):
-        return (bi, hi // n_rep, j, 0)
-
-    def hrow_q(bi, hi, i, j):
-        return (bi, hi, i)
+        return (bi, hi // n_rep, clamp_k(i, j), 0)
 
     # dq: grid inner dim iterates kv blocks
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale_v, causal=causal,
-                          use_segments=use_segments),
+                          use_segments=use_segments, block_q=block_q,
+                          block_k=block_k, block_skip=skip),
         grid=(b, h, sq_p // block_q, sk_p // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q), qrow),
-            pl.BlockSpec((1, block_k), krow),
-            pl.BlockSpec((1, block_q), qrow),
-            pl.BlockSpec((1, block_k), krow),
+            pl.BlockSpec((1, block_q, LANES), qrow),
+            pl.BlockSpec((1, SUBLANES, block_k), krow),
+            pl.BlockSpec((1, block_q, LANES), qrow),
+            pl.BlockSpec((1, SUBLANES, block_k), krow),
             pl.BlockSpec((1, 1, block_q, d), hq),
             pl.BlockSpec((1, 1, block_k, d), hk),
             pl.BlockSpec((1, 1, block_k, d), hk),
             pl.BlockSpec((1, 1, block_q, d), hq),
-            pl.BlockSpec((1, 1, block_q), hrow_q),
-            pl.BlockSpec((1, 1, block_q), hrow_q),
+            pl.BlockSpec((1, 1, block_q, LANES), hq),
+            pl.BlockSpec((1, 1, block_q, LANES), hq),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), hq),
         out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q_pos_p, kv_pos_p, q_seg_p, kv_seg_p, qT, kT, vT, doT, lseT, deltaT)
+    )(q_pos_l, kv_pos_s, q_seg_l, kv_seg_s, qT, kT, vT, doT, lseT, deltaT)
 
     # dk/dv: grid inner dim iterates q blocks
     def hq2(bi, hi, j, i):
-        return (bi, hi, i, 0)
+        return (bi, hi, clamp_q(j, i), 0)
+
+    def qrow2(bi, hi, j, i):
+        return (bi, clamp_q(j, i), 0)
 
     def hk2_read(bi, hi, j, i):
         return (bi, hi // n_rep, j, 0)
@@ -402,19 +513,22 @@ def _vjp_bwd(causal, scale, block_q, block_k, res, g):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale_v, causal=causal,
-                          use_segments=use_segments),
+                          use_segments=use_segments, block_q=block_q,
+                          block_k=block_k, block_skip=skip),
         grid=(b, h, sk_p // block_k, sq_p // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q), lambda bi, hi, j, i: (bi, i)),
-            pl.BlockSpec((1, block_k), lambda bi, hi, j, i: (bi, j)),
-            pl.BlockSpec((1, block_q), lambda bi, hi, j, i: (bi, i)),
-            pl.BlockSpec((1, block_k), lambda bi, hi, j, i: (bi, j)),
+            pl.BlockSpec((1, block_q, LANES), qrow2),
+            pl.BlockSpec((1, SUBLANES, block_k),
+                         lambda bi, hi, j, i: (bi, 0, j)),
+            pl.BlockSpec((1, block_q, LANES), qrow2),
+            pl.BlockSpec((1, SUBLANES, block_k),
+                         lambda bi, hi, j, i: (bi, 0, j)),
             pl.BlockSpec((1, 1, block_q, d), hq2),
             pl.BlockSpec((1, 1, block_k, d), hk2_read),
             pl.BlockSpec((1, 1, block_k, d), hk2_read),
             pl.BlockSpec((1, 1, block_q, d), hq2),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, j, i: (bi, hi, i)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, j, i: (bi, hi, i)),
+            pl.BlockSpec((1, 1, block_q, LANES), hq2),
+            pl.BlockSpec((1, 1, block_q, LANES), hq2),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), hk2_write),
@@ -429,7 +543,7 @@ def _vjp_bwd(causal, scale, block_q, block_k, res, g):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q_pos_p, kv_pos_p, q_seg_p, kv_seg_p, qT, kT, vT, doT, lseT, deltaT)
+    )(q_pos_l, kv_pos_s, q_seg_l, kv_seg_s, qT, kT, vT, doT, lseT, deltaT)
 
     dq = jnp.swapaxes(dq[:, :, :sq], 1, 2)
     # dk/dv come back at full q-head width; fold the n_rep group back onto

@@ -142,3 +142,28 @@ def test_gradients_with_segments():
     for a, b_, name in zip(gf, gr, "qkv"):
         np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-4,
                                    err_msg=f"d{name}")
+
+
+def test_gradients_kv_longer_than_q_causal():
+    """sk > sq with causal block skip: kv blocks entirely past the last q
+    block must produce dk/dv == 0, not stale scratch from the previous
+    block (regression: _first_valid_q lacked the num_q-1 clamp)."""
+    q, k, v, q_pos, kv_pos = make_inputs(b=1, sq=32, sk=128, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, q_pos, kv_pos, None, None, True, None,
+                            32, 32)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = oracle(q, k, v, q_pos, kv_pos)
+        return jnp.sum(o * jnp.cos(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    # Keys at positions > max q position get exactly zero gradient.
+    np.testing.assert_array_equal(np.asarray(gf[1][:, 32:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(gf[2][:, 32:]), 0.0)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name}")
