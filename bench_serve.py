@@ -42,9 +42,19 @@ def inner() -> None:
     max_tokens = int(os.environ.get("RBT_BENCH_MAXTOK",
                                     64 if on_tpu else 8))
 
+    chunk = os.environ.get("RBT_BENCH_CHUNK")
+    chunk = int(chunk) if chunk else None  # None => engine auto (8 on TPU)
+    # Engine context window: bounds the warmup compile set (every prefill
+    # bucket × {1, slots} rows + every decode view is its own XLA program;
+    # at 2048 over the relay that is ~20 compiles and blows the bench
+    # timeout). 512 covers prompt+max_tokens with a bucket to spare.
+    max_seq = int(os.environ.get("RBT_BENCH_MAXSEQ", 512 if on_tpu else 0))
+
     cfg = get_config(model, param_dtype="bfloat16" if on_tpu else "float32")
     params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
-    engine = InferenceEngine(cfg, params, max_slots=slots)
+    engine = InferenceEngine(cfg, params, max_slots=slots,
+                             max_seq_len=max_seq or None,
+                             decode_chunk=chunk)
     engine.warmup()
     worker = EngineWorker(engine)
 
@@ -95,6 +105,7 @@ def inner() -> None:
         "ttft_p90_ms": round(sorted(ttfts)[int(0.9 * len(ttfts)) - 1] * 1000,
                              1),
         "decode_tokens_per_sec": round(total_tokens / wall, 1),
+        "decode_chunk": engine.decode_chunk,
         "platform": jax.default_backend(),
         "device": str(device),
     }))

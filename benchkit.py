@@ -105,6 +105,13 @@ def _run_inner(script: str, env: dict, timeout: float):
 
 def run_outer(script: str, fallback_metric: str, unit: str) -> None:
     """Orchestrate TPU-then-CPU attempts of ``script``; always print JSON."""
+    print(json.dumps(measure_outer(script, fallback_metric, unit)))
+
+
+def measure_outer(script: str, fallback_metric: str, unit: str) -> dict:
+    """Like run_outer but returns the result dict instead of printing, so a
+    caller can compose several benchmarks into one driver-visible JSON line
+    (bench.py folds bench_serve's TTFT/decode numbers in this way)."""
     errors: list[str] = []
     result = None
     tpu_timeout = float(os.environ.get("RBT_BENCH_TPU_TIMEOUT", 1200))
@@ -139,4 +146,4 @@ def run_outer(script: str, fallback_metric: str, unit: str) -> None:
                   "vs_baseline": 0.0, "platform": "none"}
     if errors:
         result["bench_errors"] = errors
-    print(json.dumps(result))
+    return result

@@ -77,7 +77,8 @@ def make_manager(ctx):
     ])
 
 
-def run_with_leader_election(mgr, elector, stop, poll_s: float = 0.5):
+def run_with_leader_election(mgr, elector, stop, poll_s: float = 0.5,
+                             resync_seconds: float = 30.0):
     """Run the manager only while holding the lease: acquire -> reconcile;
     lose -> stop reconciling (watch loops wound down); reacquire -> run
     again. Standbys idle in the wait loop. (Reference analog: controller-
@@ -92,7 +93,7 @@ def run_with_leader_election(mgr, elector, stop, poll_s: float = 0.5):
                 leader_stop.set()
 
             threading.Thread(target=watch_leadership, daemon=True).start()
-            mgr.run(leader_stop)
+            mgr.run(leader_stop, resync_seconds=resync_seconds)
 
 
 class _Health(BaseHTTPRequestHandler):

@@ -104,6 +104,13 @@ class ModelConfig:
     # "stage" axis > 1 (parallel/pipeline.py). 0 = one microbatch per
     # stage; more microbatches shrink the (S-1)/(S+M-1) bubble.
     pipeline_microbatches: int = 0
+    # Training schedule when stage > 1: "1f1b" (default) runs the explicit
+    # fwd/bwd-interleaved schedule with in-flight activations bounded by
+    # O(stages) regardless of microbatch count (parallel/pipeline.py:
+    # pipeline_1f1b_grads); "gpipe" differentiates through the forward
+    # pipeline (simpler, O(microbatches) live activations — the oracle the
+    # 1F1B parity tests compare against).
+    pipeline_schedule: str = "1f1b"
 
     @property
     def activation_dtype(self):

@@ -350,7 +350,7 @@ def _attention_block(
 
     new_layer_cache = None
     if layer_cache is not None:
-        ck, cv, index = layer_cache
+        ck, cv, index, view = layer_cache
         if index is None:
             # Position-scatter mode: row b token j -> slot positions[b, j].
             cache_len = ck.shape[1]
@@ -361,7 +361,13 @@ def _attention_block(
         else:
             ck = jax.lax.dynamic_update_slice(ck, k, (0, index, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v, (0, index, 0, 0))
-        k, v = ck, cv
+        # Writes go to the FULL cache; attention READS only [0, view).
+        # Exact for any view > max query position: slot s is attended only
+        # by queries at positions >= s, so slots beyond the view hold
+        # nothing a masked-in query could see. Serving uses this to stop
+        # decode from streaming the whole max-length cache through HBM
+        # when occupancy is low (the decode step is bandwidth-bound).
+        k, v = (ck, cv) if view is None else (ck[:, :view], cv[:, :view])
         new_layer_cache = (ck, cv)
         # Decode/prefill-with-cache always uses the XLA path (kernels cover
         # the training shapes; cache attention is bandwidth-bound anyway).
@@ -446,6 +452,7 @@ def forward(
     positions: Optional[jax.Array] = None,  # [b, s] absolute positions
     segment_ids: Optional[jax.Array] = None,  # [b, s] packed-seq ids (0 = pad)
     cache: Optional[KVCache] = None,
+    cache_view: Optional[int] = None,
     remat: bool = False,
     with_aux: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
@@ -456,6 +463,12 @@ def forward(
     Without cache: standard training/eval forward, causal + segment masking.
     With cache: tokens are appended at cache.index (prefill chunks or single-
     token decode); positions default to index + arange(s).
+
+    cache_view (static): attention reads only cache slots [0, cache_view) —
+    writes still land in the full cache. Exact whenever every query position
+    is < cache_view; the serving engine picks the smallest bucketed view
+    covering current occupancy so decode doesn't stream the whole
+    max-length cache through HBM each step.
     """
     b, s = tokens.shape
     ad = cfg.activation_dtype
@@ -500,9 +513,9 @@ def forward(
         x = x + params["pos_embed"].astype(ad)[positions]
     x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
-    # Mask & bias over the full kv extent.
+    # Mask & bias over the full kv extent (or the static read view).
     if cache is not None:
-        max_kv = cache.k.shape[2]
+        max_kv = cache_view if cache_view is not None else cache.k.shape[2]
         kv_positions = jnp.broadcast_to(
             jnp.arange(max_kv, dtype=jnp.int32)[None, :], (b, max_kv))
         # Slots at arange > q position are either future or unwritten: the
@@ -532,7 +545,8 @@ def forward(
         x, aux_sum = carry
         if cache is not None:
             layer, ck, cv = scanned
-            layer_cache = (ck, cv, None if scatter_mode else cache.index)
+            layer_cache = (ck, cv, None if scatter_mode else cache.index,
+                           cache_view)
         else:
             layer = scanned
             layer_cache = None
@@ -584,6 +598,125 @@ def forward(
     if with_aux:
         return logits, new_cache, aux_total
     return logits, new_cache
+
+
+def loss_and_grads_1f1b(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,                      # [b, s] int32
+    targets: jax.Array,                     # [b, s] int32
+    loss_mask: Optional[jax.Array] = None,  # [b, s] float {0,1}
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Params, jax.Array]:
+    """Masked-mean CE loss + grads via the 1F1B pipeline schedule.
+
+    Numerically equivalent to
+    ``jax.value_and_grad(ce(forward(...)))`` on a stage>1 mesh (the GPipe
+    autodiff path is the test oracle), but the backward is explicit: the
+    pipeline interleaves per-microbatch vjp ticks so in-flight activations
+    are O(stages) and full-batch logits never materialize (see
+    parallel/pipeline.pipeline_1f1b_grads). Embedding fwd/bwd runs outside
+    the pipeline via jax.vjp; head grads (incl. tied-embedding head) come
+    back from the last stage and are tree-added.
+
+    Returns (loss, grads, total_weight) with grads matching params'
+    structure — a drop-in for the value_and_grad call in train/step.py.
+    """
+    from runbooks_tpu.parallel.pipeline import pipeline_1f1b_grads
+    from runbooks_tpu.parallel.sharding import _current_mesh
+
+    mesh = _current_mesh()
+    n_stages = int(mesh.shape.get("stage", 1)) if mesh is not None else 1
+    if n_stages <= 1:
+        raise ValueError("loss_and_grads_1f1b needs a mesh with stage > 1")
+    b, s = tokens.shape
+    ad = cfg.activation_dtype
+    M = cfg.pipeline_microbatches or n_stages
+
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+
+    weights = (jnp.ones((b, s), jnp.float32) if loss_mask is None
+               else loss_mask.astype(jnp.float32))
+    total_weight = jnp.maximum(jnp.sum(weights), 1.0)
+    inv_total = 1.0 / total_weight
+
+    nl_params = {k: v for k, v in params.items() if k != "layers"}
+
+    def embed_fn(nl):
+        use_one_hot = cfg.embed_one_hot
+        if use_one_hot is None:
+            m0 = _current_mesh()
+            use_one_hot = (m0 is not None
+                           and int(m0.shape.get("tensor", 1)) > 1)
+        if use_one_hot:
+            one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=ad)
+            x = jnp.einsum("bsv,vh->bsh", one_hot, nl["embed"].astype(ad),
+                           preferred_element_type=jnp.float32).astype(ad)
+        else:
+            x = nl["embed"].astype(ad)[tokens]
+        if cfg.embed_scale:
+            x = x * (cfg.hidden_size ** 0.5)
+        if cfg.position_type == "learned":
+            x = x + nl["pos_embed"].astype(ad)[positions]
+        return with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+    x, embed_vjp = jax.vjp(embed_fn, nl_params)
+
+    # Mask/bias exactly as the no-cache forward builds them.
+    if resolve_attention_impl(cfg) == "flash":
+        mask = None
+    else:
+        mask = make_attention_mask(positions, positions, segment_ids,
+                                   segment_ids, causal=True)
+    bias = None
+    if cfg.position_type == "alibi":
+        slopes = alibi_slopes(cfg.num_heads)
+        rel = (positions[:, None, :]
+               - positions[:, :, None]).astype(jnp.float32)
+        bias = slopes[None, :, None, None] * rel[:, None, :, :]
+
+    def blk_fn(layer, xx, mb_consts):
+        pos, seg, mk, bs = mb_consts
+        y, _, aux = _block(cfg, layer, xx, pos, seg, mk, bs, None)
+        return y, aux
+
+    def head_loss_fn(nl, y, lc):
+        tgt, w = lc
+        h = _norm(cfg, nl["final_norm"], y)
+        head = nl["embed"].T if cfg.tie_embeddings else nl["head"]
+        logits = jnp.einsum("bsh,hv->bsv", h.astype(ad), head.astype(ad),
+                            preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        # One-hot select, NOT take_along_axis: the gather's transpose is a
+        # scatter-add into the tensor-sharded logits, which crashes the
+        # GSPMD partitioner inside the stage-manual shard_map
+        # (spmd_partitioner_util.cc CHECK, reduced and verified); the
+        # masked-sum transpose is a broadcast-multiply and partitions
+        # cleanly (and is exactly how embed_one_hot sidesteps the same
+        # class of problem on the embedding side).
+        onehot = (jnp.arange(logits.shape[-1], dtype=tgt.dtype)[None, None]
+                  == tgt[..., None])
+        nll = -jnp.sum(jnp.where(onehot, logp, 0.0), axis=-1)
+        return jnp.sum(nll * w) * inv_total
+
+    aux_scale = (cfg.moe_aux_coef / M) if cfg.moe_num_experts else 0.0
+    loss_sum, layer_grads, head_grads, dx, aux_mean = pipeline_1f1b_grads(
+        blk_fn, head_loss_fn, params["layers"], nl_params, x,
+        (positions, segment_ids, mask, bias), (targets, weights),
+        mesh=mesh, n_stages=n_stages, n_microbatches=M,
+        aux_scale=aux_scale)
+
+    (embed_grads,) = embed_vjp(dx)
+    nl_grads = jax.tree.map(lambda a, g: a + g, embed_grads, head_grads)
+    grads = dict(nl_grads)
+    grads["layers"] = layer_grads
+    loss = loss_sum
+    if cfg.moe_num_experts:
+        loss = loss + cfg.moe_aux_coef * aux_mean
+    return loss, grads, total_weight
 
 
 def _remat_policy(name: str):

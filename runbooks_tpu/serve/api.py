@@ -154,11 +154,13 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   max_slots: int = 8,
                   max_seq_len: Optional[int] = None,
                   mesh=None, warmup: bool = False,
-                  prefill_budget: Optional[int] = None) -> web.Application:
+                  prefill_budget: Optional[int] = None,
+                  decode_chunk: Optional[int] = None) -> web.Application:
     tokenizer = tokenizer or load_tokenizer(None)
     engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
                              max_seq_len=max_seq_len, mesh=mesh,
-                             prefill_budget=prefill_budget)
+                             prefill_budget=prefill_budget,
+                             decode_chunk=decode_chunk)
     if warmup:
         engine.warmup()  # pre-compile all buckets before readiness flips
     worker = EngineWorker(engine)

@@ -6,11 +6,22 @@ a Deployment on port 8080 — internal/controller/server_controller.go). Here
 inference is in-framework and TPU-shaped:
 
 - Static shapes everywhere: a fixed pool of B slots, a fixed cache length,
-  bucketed prefill lengths — so there are exactly (num_buckets + 1) compiled
-  programs (prefills + one decode step) and no recompiles at serve time.
-- Continuous batching at slot granularity: between decode steps, finished
+  bucketed prefill lengths and row counts — so the compiled-program set is
+  small and fixed (prefill per (bucket, rows) + one decode chunk) and there
+  are no recompiles at serve time.
+- Continuous batching at slot granularity: between decode chunks, finished
   slots are freed and queued requests prefill into free slots; every decode
   step advances all active slots at once (one [B,1] forward).
+- Decode runs ``decode_chunk`` steps per host round-trip (a lax.scan with
+  on-device EOS/limit tracking), because on TPU a per-step host sync
+  dominates small-batch inter-token latency. chunk=1 reproduces classic
+  step-at-a-time behavior exactly; the host replays the device's per-step
+  validity mask so slot bookkeeping matches the single-step semantics
+  token for token.
+- Prefill is batched: requests admitted in the same tick are grouped by
+  length bucket and prefilled as one [rows, bucket] forward (rows padded to
+  a power of two), so a burst costs one dispatch per bucket instead of one
+  per request.
 - Per-slot cache writes use the transformer's position-scatter mode with a
   trash slot for padding (see models/transformer.KVCache).
 - Sampling is jitted with per-slot temperature/top_k/top_p so mixed request
@@ -20,6 +31,7 @@ inference is in-framework and TPU-shaped:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Any, Callable, List, Optional
 
@@ -70,7 +82,8 @@ class InferenceEngine:
     def __init__(self, cfg: ModelConfig, params: Params, *,
                  max_slots: int = 8, max_seq_len: Optional[int] = None,
                  seed: int = 0, mesh=None,
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None,
+                 decode_chunk: Optional[int] = None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
@@ -84,10 +97,24 @@ class InferenceEngine:
         latency while decode throughput continues. Default: max_seq_len
         (≈ one full-length prefill worth per step). A single over-budget
         request still admits alone — the budget shapes bursts, it never
-        starves."""
+        starves.
+
+        decode_chunk: decode steps run on-device per host round-trip.
+        Each step() call scans `chunk` forwards in one jit call, tracking
+        EOS / max_tokens / out-of-room per slot on device, and replays the
+        emitted tokens on the host afterwards. Larger chunks amortize the
+        host↔device sync (the dominant per-token cost at small batch on
+        TPU) at the price of admission latency ≤ chunk-1 extra steps and
+        streaming granularity of ≤ chunk tokens. Default: 8 on TPU, 1
+        elsewhere (CPU dispatch is cheap and tests want step-at-a-time)."""
         self.cfg = cfg
         self.mesh = mesh
         self.prefill_budget = prefill_budget
+        if decode_chunk is None:
+            decode_chunk = 8 if "tpu" in jax.default_backend().lower() else 1
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
+        self.decode_chunk = decode_chunk
         if mesh is not None and int(mesh.shape.get("stage", 1)) > 1:
             raise ValueError(
                 "pipeline (stage) parallelism is a training-path feature; "
@@ -145,13 +172,25 @@ class InferenceEngine:
 
         cache_len = self.max_seq_len + 1
 
-        def prefill_fn(params, cache_k, cache_v, tokens, positions, slot):
-            # Prefill one request into a fresh zero row, then splice the row
-            # into the pool cache (donated => in-place, no full-cache copy).
-            # Stale data from the slot's previous occupant needs no clearing:
-            # this request's queries only ever attend slots <= their own
-            # position, all of which this prefill/decode has (re)written.
-            row_shape = (cfg.num_layers, 1, cache_len, cfg.num_kv_heads,
+        def prefill_fn(params, cache_k, cache_v, tokens, positions, slots,
+                       last_pos, rng, temps, top_ks, top_ps):
+            # Prefill `rows` requests into fresh zero rows at once, then
+            # splice each row into the pool cache (donated => in-place, no
+            # full-cache copy). Stale data from a slot's previous occupant
+            # needs no clearing: this request's queries only ever attend
+            # slots <= their own position, all of which this prefill/decode
+            # has (re)written. Padding rows (beyond the real requests)
+            # carry slots[0] as their destination; the splice loop runs in
+            # DESCENDING row order so the real row 0 is written last and
+            # overwrites any padding garbage at that slot.
+            #
+            # First-token sampling lives INSIDE the jit: an eager sampling
+            # chain here compiled ~20 tiny relay programs at the first
+            # admission (~27 s of TTFT, measured) that warmup never hit.
+            # One dispatch also means one host round-trip per admission
+            # group. rng advances functionally (split in, successor out).
+            rows = tokens.shape[0]
+            row_shape = (cfg.num_layers, rows, cache_len, cfg.num_kv_heads,
                          cfg.head_dim)
             cache1 = KVCache(
                 k=jnp.zeros(row_shape, cfg.activation_dtype),
@@ -159,48 +198,118 @@ class InferenceEngine:
                 index=jnp.zeros((), jnp.int32))
             logits, cache1 = forward(cfg, params, tokens,
                                      positions=positions, cache=cache1)
-            new_k = jax.lax.dynamic_update_slice_in_dim(
-                cache_k, cache1.k, slot, axis=1)
-            new_v = jax.lax.dynamic_update_slice_in_dim(
-                cache_v, cache1.v, slot, axis=1)
-            return logits, new_k, new_v
+            new_k, new_v = cache_k, cache_v
+            for r in range(rows - 1, -1, -1):
+                new_k = jax.lax.dynamic_update_slice_in_dim(
+                    new_k, cache1.k[:, r:r + 1], slots[r], axis=1)
+                new_v = jax.lax.dynamic_update_slice_in_dim(
+                    new_v, cache1.v[:, r:r + 1], slots[r], axis=1)
+            rng, sub = jax.random.split(rng)
+            last_logits = jnp.take_along_axis(
+                logits, last_pos[:, None, None], axis=1)[:, 0]
+            first = sample(last_logits, sub, temps, top_ks, top_ps)
+            return first, new_k, new_v, rng
 
         self._prefill = jax.jit(prefill_fn, donate_argnums=(1, 2))
 
-        def decode_fn(params, cache, tokens, positions, rng,
-                      temperature, top_k, top_p):
-            logits, cache = forward(cfg, params, tokens,
-                                    positions=positions, cache=cache)
-            next_tok = sample(logits[:, -1], rng, temperature, top_k, top_p)
-            return next_tok, cache
+        chunk = self.decode_chunk
+        max_len = self.max_seq_len
 
-        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+        # Decode reads the cache through a static bucketed VIEW sized to
+        # current occupancy (see forward(cache_view=...)): the step is HBM-
+        # bandwidth-bound, and low occupancy shouldn't pay for streaming
+        # the whole max-length cache. One compiled program per view bucket;
+        # writes (incl. trash-slot parking) always target the full cache.
+        self.view_buckets = sorted(
+            {v for v in (256, 1024) if v < self.max_seq_len}
+            | {self.max_seq_len})
+        self._decode_fns: dict = {}
 
-    def warmup(self) -> None:
-        """Compile every prefill bucket + the decode step ahead of traffic
-        (first-request latency otherwise pays 1-2 compiles). Slot state is
-        reset afterwards."""
+        def decode_fn(view, params, cache, tokens, positions, rng,
+                      temperature, top_k, top_p, eos_ids, remaining, active):
+            # `chunk` decode steps in one jit call (lax.scan). Per-slot
+            # liveness is tracked ON DEVICE with exactly the host's finish
+            # rules (EOS, max_tokens budget, cache out-of-room), so the
+            # host can replay (tokens, valid) afterwards and land in the
+            # same slot state as chunk=1 step-at-a-time would. rng advances
+            # functionally (successor key returned) — no eager split on the
+            # host per chunk.
+            rng, step_rng = jax.random.split(rng)
+            keys = jax.random.split(step_rng, chunk)
+
+            def body(carry, key):
+                cache, tok, pos, alive, emitted = carry
+                p = jnp.where(alive, pos, self._pad_slot)
+                logits, cache = forward(cfg, params, tok[:, None],
+                                        positions=p[:, None], cache=cache,
+                                        cache_view=view)
+                nxt = sample(logits[:, -1], key, temperature, top_k, top_p)
+                nxt = jnp.where(alive, nxt, tok)
+                out = (nxt, alive)
+                emitted = emitted + alive
+                pos = pos + alive
+                hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
+                alive = (alive & ~hit_eos & (emitted < remaining)
+                         & (pos < max_len))
+                return (cache, nxt, pos, alive, emitted), out
+
+            init = (cache, tokens, positions, active,
+                    jnp.zeros_like(remaining))
+            (cache, *_), (toks, valid) = jax.lax.scan(body, init, keys)
+            return toks, valid, cache, rng
+
+        def decode_for(view: int):
+            if view not in self._decode_fns:
+                self._decode_fns[view] = jax.jit(
+                    functools.partial(decode_fn, view), donate_argnums=(1,))
+            return self._decode_fns[view]
+
+        self._decode_for = decode_for
+
+    def _view_for(self, max_pos: int) -> int:
+        """Smallest view bucket covering every query position this chunk
+        can reach (caller passes max active length + chunk)."""
+        for v in self.view_buckets:
+            if max_pos <= v:
+                return v
+        return self.view_buckets[-1]
+
+    def warmup(self, rows: Optional[tuple] = None) -> None:
+        """Compile prefill (every bucket × every row count in `rows`) + the
+        decode chunk ahead of traffic (first-request latency otherwise pays
+        1-2 compiles). Slot state is reset afterwards. Default rows covers
+        every shape the engine can emit: 1 (single admission) and max_slots
+        (batched burst) — each is a separate XLA program."""
+        if rows is None:
+            rows = (1, self.max_slots) if self.max_slots > 1 else (1,)
         for bucket in self.prefill_buckets:
-            padded = np.zeros((1, bucket), np.int32)
-            positions = np.full((1, bucket), self._pad_slot, np.int32)
-            positions[0, :2] = [0, 1]
-            with self._mesh_ctx():
-                _, new_k, new_v = self._prefill(
-                    self.params, self.cache.k, self.cache.v,
-                    jnp.asarray(padded), jnp.asarray(positions),
-                    jnp.asarray(0, jnp.int32))
-            self.cache = KVCache(k=new_k, v=new_v, index=self.cache.index)
+            for r in dict.fromkeys(min(r, self.max_slots) for r in rows):
+                padded = np.zeros((r, bucket), np.int32)
+                positions = np.full((r, bucket), self._pad_slot, np.int32)
+                positions[:, :2] = [0, 1]
+                with self._mesh_ctx():
+                    _, new_k, new_v, _ = self._prefill(
+                        self.params, self.cache.k, self.cache.v,
+                        jnp.asarray(padded), jnp.asarray(positions),
+                        jnp.zeros(r, jnp.int32), jnp.ones(r, jnp.int32),
+                        jax.random.key(0), jnp.zeros(r, jnp.float32),
+                        jnp.zeros(r, jnp.int32), jnp.ones(r, jnp.float32))
+                self.cache = KVCache(k=new_k, v=new_v,
+                                     index=self.cache.index)
         zeros = np.zeros(self.max_slots, np.int32)
-        with self._mesh_ctx():
-            _, self.cache = self._decode(
-                self.params, self.cache,
-                jnp.asarray(zeros[:, None]),
-                jnp.asarray(np.full((self.max_slots, 1), self._pad_slot,
-                                    np.int32)),
-                jax.random.key(0),
-                jnp.zeros(self.max_slots, jnp.float32),
-                jnp.zeros(self.max_slots, jnp.int32),
-                jnp.ones(self.max_slots, jnp.float32))
+        for view in self.view_buckets:
+            with self._mesh_ctx():
+                _, _, self.cache, _ = self._decode_for(view)(
+                    self.params, self.cache, jnp.asarray(zeros),
+                    jnp.asarray(np.full(self.max_slots, self._pad_slot,
+                                        np.int32)),
+                    jax.random.key(0),
+                    jnp.zeros(self.max_slots, jnp.float32),
+                    jnp.zeros(self.max_slots, jnp.int32),
+                    jnp.ones(self.max_slots, jnp.float32),
+                    jnp.full(self.max_slots, -1, jnp.int32),
+                    jnp.zeros(self.max_slots, jnp.int32),
+                    jnp.zeros(self.max_slots, bool))
         self.reset()
 
     # ------------------------------------------------------------------
@@ -251,7 +360,7 @@ class InferenceEngine:
 
     def _admit(self) -> None:
         budget = self.prefill_budget
-        admitted = 0
+        admitted: List[tuple] = []
         for slot in self._free_slots():
             if not self.queue:
                 break
@@ -263,38 +372,67 @@ class InferenceEngine:
                 break
             req = self.queue.pop(0)
             budget -= need
-            admitted += 1
-            self._prefill_into(slot, req)
+            admitted.append((slot, req))
+        if not admitted:
+            return
+        # Group this tick's admissions by bucket: one [rows, bucket]
+        # prefill dispatch per bucket instead of one per request.
+        by_bucket: dict = {}
+        for slot, req in admitted:
+            b = self._bucket_for(len(req.prompt_tokens))
+            by_bucket.setdefault(b, []).append((slot, req))
+        for bucket, group in by_bucket.items():
+            self._prefill_group(bucket, group)
 
-    def _prefill_into(self, slot: int, req: Request) -> None:
-        toks = req.prompt_tokens
-        n = len(toks)
-        bucket = self._bucket_for(n)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = toks
-        # Real tokens at positions 0..n-1; padding scatters to the trash slot.
-        positions = np.full((1, bucket), self._pad_slot, np.int32)
-        positions[0, :n] = np.arange(n)
+    def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
+        """Prefill same-bucket requests as one batched forward. The row
+        count is 1 (single request) or max_slots (any burst) — exactly the
+        two shapes warmup() compiles, so a burst can never trigger a
+        serve-time compile (measured on the v5e relay: one cold [8,128]
+        prefill compile cost ~27 s of TTFT). Padding rows aim at group[0]'s
+        slot and are overwritten by the real row 0 (the jitted splice runs
+        rows in descending order)."""
+        n = len(group)
+        rows = 1 if n == 1 else self.max_slots
+        tokens = np.zeros((rows, bucket), np.int32)
+        # Real tokens at positions 0..len-1; padding scatters to the trash
+        # slot of each row's scratch cache.
+        positions = np.full((rows, bucket), self._pad_slot, np.int32)
+        slots = np.full(rows, group[0][0], np.int32)
+        for i, (slot, req) in enumerate(group):
+            m = len(req.prompt_tokens)
+            tokens[i, :m] = req.prompt_tokens
+            positions[i, :m] = np.arange(m)
+            slots[i] = slot
 
+        # First generated token of each row comes from its last *real*
+        # prompt position; sampling happens inside the jitted prefill (one
+        # dispatch, no eager sampling chain — see prefill_fn).
+        last_pos = np.zeros(rows, np.int32)
+        temps = np.zeros(rows, np.float32)
+        top_ks = np.zeros(rows, np.int32)
+        top_ps = np.ones(rows, np.float32)
+        for i, (_, req) in enumerate(group):
+            last_pos[i] = len(req.prompt_tokens) - 1
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
         with self._mesh_ctx():
-            logits, new_k, new_v = self._prefill(
-                self.params, self.cache.k, self.cache.v, jnp.asarray(padded),
-                jnp.asarray(positions), jnp.asarray(slot, jnp.int32))
+            first, new_k, new_v, self.rng = self._prefill(
+                self.params, self.cache.k, self.cache.v, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(slots),
+                jnp.asarray(last_pos), self.rng, jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps))
         self.cache = KVCache(k=new_k, v=new_v, index=self.cache.index)
-        # First generated token comes from the last *real* prompt position.
-        self.rng, sub = jax.random.split(self.rng)
-        first = sample(
-            logits[:, n - 1], sub,
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32),
-            jnp.asarray([req.top_p], jnp.float32))
-        tok = int(first[0])
-        self.active[slot] = True
-        self.lengths[slot] = n
-        self.last_token[slot] = tok
-        self.slot_req[slot] = req
-        req._slot = slot
-        self._record_token(slot, tok)
+        first = np.asarray(first)
+        for i, (slot, req) in enumerate(group):
+            tok = int(first[i])
+            self.active[slot] = True
+            self.lengths[slot] = len(req.prompt_tokens)
+            self.last_token[slot] = tok
+            self.slot_req[slot] = req
+            req._slot = slot
+            self._record_token(slot, tok)
 
     def _record_token(self, slot: int, tok: int) -> None:
         req = self.slot_req[slot]
@@ -315,38 +453,57 @@ class InferenceEngine:
             self.slot_req[slot] = None
 
     def step(self) -> int:
-        """Admit queued requests, run one decode step. Returns number of
-        active slots stepped."""
+        """Admit queued requests, run one decode chunk (`decode_chunk`
+        forward steps in a single jit call). Returns the number of tokens
+        generated across slots (== active-slot count when chunk=1 and
+        nothing finishes mid-chunk)."""
         self._admit()
         if not self.active.any():
             return 0
-        tokens = jnp.asarray(self.last_token[:, None])
-        # Inactive rows decode into the trash slot at a harmless position.
+        # Inactive rows decode into the trash slot at a harmless position;
+        # mid-chunk, rows that finish are parked there by the device mask.
         positions = np.where(self.active, self.lengths,
-                             self._pad_slot).astype(np.int32)[:, None]
+                             self._pad_slot).astype(np.int32)
         temps = np.array([self.slot_req[i].temperature if self.active[i]
                           else 0.0 for i in range(self.max_slots)], np.float32)
         top_ks = np.array([self.slot_req[i].top_k if self.active[i] else 0
                            for i in range(self.max_slots)], np.int32)
         top_ps = np.array([self.slot_req[i].top_p if self.active[i] else 1.0
                            for i in range(self.max_slots)], np.float32)
-        self.rng, sub = jax.random.split(self.rng)
+        # Device-side finish tracking mirrors _record_token: EOS id (-1 =
+        # none), tokens left in the request budget, room left in the cache.
+        eos_ids = np.array([
+            self.slot_req[i].eos_id
+            if self.active[i] and self.slot_req[i].eos_id is not None else -1
+            for i in range(self.max_slots)], np.int32)
+        remaining = np.array([
+            self.slot_req[i].max_tokens - len(self.slot_req[i].output_tokens)
+            if self.active[i] else 0
+            for i in range(self.max_slots)], np.int32)
+        view = self._view_for(int(self.lengths[self.active].max())
+                              + self.decode_chunk)
         with self._mesh_ctx():
-            next_tok, self.cache = self._decode(
-                self.params, self.cache, tokens, jnp.asarray(positions), sub,
-                jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps))
-        next_tok = np.asarray(next_tok)
-        stepped = 0
-        for slot in range(self.max_slots):
-            if not self.active[slot]:
-                continue
-            stepped += 1
-            self.lengths[slot] += 1
-            tok = int(next_tok[slot])
-            self.last_token[slot] = tok
-            self._record_token(slot, tok)
+            toks, valid, self.cache, self.rng = self._decode_for(view)(
+                self.params, self.cache, jnp.asarray(self.last_token),
+                jnp.asarray(positions), self.rng,
+                jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+                jnp.asarray(eos_ids), jnp.asarray(remaining),
+                jnp.asarray(self.active))
+        toks = np.asarray(toks)          # [chunk, slots]
+        valid = np.asarray(valid)        # [chunk, slots] bool
+        # Replay the chunk on the host: `valid[k]` is exactly the set of
+        # slots that were alive at device step k, so this loop lands in the
+        # same bookkeeping state as chunk=1 stepping would.
+        generated = 0
+        for k in range(toks.shape[0]):
+            for slot in np.nonzero(valid[k])[0]:
+                generated += 1
+                self.lengths[slot] += 1
+                tok = int(toks[k, slot])
+                self.last_token[slot] = tok
+                self._record_token(slot, tok)
         self.steps += 1
-        return stepped
+        return generated
 
     # ------------------------------------------------------------------
     # Convenience synchronous generation

@@ -132,23 +132,43 @@ def make_train_step(
     segment_ids [b,s], positions [b,s].
     """
 
-    def step_fn(state: TrainState, batch: Batch):
-        def loss_fn(params):
-            logits, _, aux = forward(
-                cfg, params, batch["tokens"],
-                positions=batch.get("positions"),
-                segment_ids=batch.get("segment_ids"),
-                remat=remat,
-                with_aux=True,
-            )
-            loss, total = cross_entropy_loss(
-                logits, batch["targets"], batch.get("loss_mask"))
-            if cfg.moe_num_experts:
-                loss = loss + cfg.moe_aux_coef * aux
-            return loss, total
+    n_stages = int(mesh.shape.get("stage", 1))
+    use_1f1b = n_stages > 1 and cfg.pipeline_schedule == "1f1b"
+    if cfg.pipeline_schedule not in ("1f1b", "gpipe"):
+        raise ValueError(
+            f"unknown pipeline_schedule {cfg.pipeline_schedule!r}; "
+            "expected 1f1b|gpipe")
 
-        (loss, total_weight), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+    def step_fn(state: TrainState, batch: Batch):
+        if use_1f1b:
+            # Explicit-backward pipeline: in-flight activations bounded by
+            # O(stages), no full-batch logits (models/transformer.py:
+            # loss_and_grads_1f1b). The gpipe schedule below is the
+            # autodiff oracle it is tested against.
+            from runbooks_tpu.models.transformer import loss_and_grads_1f1b
+
+            loss, grads, total_weight = loss_and_grads_1f1b(
+                cfg, state.params, batch["tokens"], batch["targets"],
+                batch.get("loss_mask"),
+                positions=batch.get("positions"),
+                segment_ids=batch.get("segment_ids"))
+        else:
+            def loss_fn(params):
+                logits, _, aux = forward(
+                    cfg, params, batch["tokens"],
+                    positions=batch.get("positions"),
+                    segment_ids=batch.get("segment_ids"),
+                    remat=remat,
+                    with_aux=True,
+                )
+                loss, total = cross_entropy_loss(
+                    logits, batch["targets"], batch.get("loss_mask"))
+                if cfg.moe_num_experts:
+                    loss = loss + cfg.moe_aux_coef * aux
+                return loss, total
+
+            (loss, total_weight), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

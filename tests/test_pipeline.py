@@ -125,6 +125,147 @@ def test_pipeline_rejects_indivisible():
             jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)
 
 
+def loss_weight_grads_ref(cfg, params, tokens, targets, mask=None):
+    """Oracle: plain autodiff CE loss/grads (runs GPipe when the active
+    mesh has stage > 1, plain scan otherwise)."""
+    from runbooks_tpu.train.step import cross_entropy_loss
+
+    def loss_fn(p):
+        logits, _, aux = forward(cfg, p, tokens, with_aux=True)
+        loss, total = cross_entropy_loss(logits, targets, mask)
+        if cfg.moe_num_experts:
+            loss = loss + cfg.moe_aux_coef * aux
+        return loss, total
+
+    (loss, total), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    return loss, grads, total
+
+
+def test_1f1b_matches_autodiff_grads():
+    """The explicit 1F1B backward must reproduce plain-autodiff loss and
+    grads exactly (same math, different schedule) — including with more
+    microbatches than stages and a non-trivial loss mask."""
+    from runbooks_tpu.models.transformer import loss_and_grads_1f1b
+
+    cfg = pp_cfg(pipeline_microbatches=4)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg)
+    targets = batch_tokens(cfg, seed=1)
+    rng = np.random.default_rng(2)
+    mask = jnp.asarray(rng.integers(0, 2, tokens.shape), jnp.float32)
+
+    plain = make_mesh(MeshConfig(fsdp=8))
+    with jax.set_mesh(plain):
+        want_loss, want_grads, want_total = jax.jit(
+            lambda p: loss_weight_grads_ref(cfg, p, tokens, targets, mask)
+        )(params)
+
+    pp_mesh = make_mesh(MeshConfig(stage=2, fsdp=4))
+    with jax.set_mesh(pp_mesh):
+        got_loss, got_grads, got_total = jax.jit(
+            lambda p: loss_and_grads_1f1b(cfg, p, tokens, targets, mask)
+        )(params)
+
+    assert float(got_total) == float(want_total)
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5)
+    flat_w, tw = jax.tree.flatten(want_grads)
+    flat_g, tg = jax.tree.flatten(got_grads)
+    assert tw == tg
+    for w, g in zip(flat_w, flat_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_train_step_matches_gpipe_step():
+    """Full train step through both schedules from identical state: same
+    loss metric, same updated params (1F1B is a reschedule, not a
+    different optimizer path)."""
+    from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
+    from runbooks_tpu.train.step import create_train_state, make_train_step
+
+    tokens = None
+    results = {}
+    for schedule in ("gpipe", "1f1b"):
+        cfg = pp_cfg(pipeline_schedule=schedule, pipeline_microbatches=4)
+        mesh = make_mesh(MeshConfig(stage=2, fsdp=4))
+        opt = make_optimizer(OptimizerConfig(total_steps=4, warmup_steps=0))
+        state, shardings = create_train_state(cfg, opt, mesh,
+                                              jax.random.key(0))
+        step = make_train_step(cfg, opt, mesh, shardings)
+        if tokens is None:
+            tokens = np.asarray(batch_tokens(cfg, b=8, s=13))
+        batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:],
+                 "loss_mask": np.ones((8, 12), np.float32)}
+        with jax.set_mesh(mesh):
+            state, metrics = step(state, batch)
+        results[schedule] = (float(metrics["loss"]),
+                             jax.tree.map(np.asarray, state.params))
+    assert np.isclose(results["gpipe"][0], results["1f1b"][0], rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(results["gpipe"][1]),
+                    jax.tree.leaves(results["1f1b"][1])):
+        np.testing.assert_allclose(b, a, rtol=5e-4, atol=5e-5)
+
+
+def test_1f1b_rejects_indivisible_microbatches():
+    from runbooks_tpu.models.transformer import loss_and_grads_1f1b
+
+    cfg = pp_cfg(pipeline_microbatches=3)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg, b=6)
+    mesh = make_mesh(MeshConfig(stage=2, fsdp=4))
+    with jax.set_mesh(mesh):
+        with pytest.raises(ValueError, match="divisible by"):
+            jax.jit(lambda p: loss_and_grads_1f1b(
+                cfg, p, tokens, tokens))(params)
+
+
+def test_1f1b_activation_memory_bounded_by_stages():
+    """1F1B's cross-tick activation state is a ring of min(M, 2S-1)
+    microbatch inputs (+ the dx bank), while GPipe autodiff tapes every
+    microbatch's per-layer activations. At CONSTANT microbatch size
+    (batch grows with M), GPipe's tape grows by a full per-microbatch
+    activation set for every added microbatch; 1F1B adds only the dx-bank
+    row. Compare compiled temp growth M=2 -> M=8 on a 2-stage mesh."""
+    from runbooks_tpu.models.transformer import loss_and_grads_1f1b
+
+    if "cpu" in jax.default_backend().lower():
+        # Measured: CPU temp_size_in_bytes grows ~equally for both
+        # schedules at constant microbatch size (~0.4 MB/mb) — it reports
+        # allocation totals without liveness-based reuse across the
+        # unrolled ticks, so the cross-tick bound is invisible. TPU
+        # buffer assignment is liveness-accurate; the comparison runs
+        # there (BENCH_NOTES.md records it when relay hardware is up).
+        pytest.skip("CPU memory_analysis lacks cross-tick buffer reuse")
+
+    mesh = make_mesh(MeshConfig(stage=2, fsdp=4))
+    mb_rows = 4  # microbatch size held constant
+
+    def temp_bytes(schedule, m):
+        cfg = pp_cfg(pipeline_microbatches=m, pipeline_schedule=schedule,
+                     num_layers=4, remat_policy="none")
+        params = init_params(cfg, jax.random.key(0))
+        tokens = batch_tokens(cfg, b=mb_rows * m, s=16)
+        targets = batch_tokens(cfg, b=mb_rows * m, s=16, seed=1)
+        with jax.set_mesh(mesh):
+            if schedule == "1f1b":
+                fn = jax.jit(lambda p: loss_and_grads_1f1b(
+                    cfg, p, tokens, targets))
+            else:
+                fn = jax.jit(lambda p: loss_weight_grads_ref(
+                    cfg, p, tokens, targets))
+            mem = fn.lower(params).compile().memory_analysis()
+        if mem is None:
+            pytest.skip("memory_analysis unavailable on this backend")
+        return mem.temp_size_in_bytes
+
+    gpipe_growth = temp_bytes("gpipe", 8) - temp_bytes("gpipe", 2)
+    f1b_growth = temp_bytes("1f1b", 8) - temp_bytes("1f1b", 2)
+    assert f1b_growth < max(gpipe_growth / 2, 1), \
+        (f1b_growth, gpipe_growth)
+
+
 def test_pipeline_composes_with_ring_attention():
     """SP (ring attention over the sequence axis) inside PP stages: nested
     shard_map (stage manual outside, sequence manual inside) must match the

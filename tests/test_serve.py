@@ -306,6 +306,89 @@ def test_http_streaming_sse():
     asyncio.run(drive())
 
 
+def test_engine_chunked_decode_matches_single_step():
+    """decode_chunk>1 (the TPU default: K scan steps per host round-trip)
+    must emit token-for-token what chunk=1 stepping emits — including
+    requests that hit EOS or max_tokens MID-chunk (device liveness mask)."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    prompts = [[5, 9, 17], [3, 4, 5, 6, 7, 8, 9, 10], [42]]
+    expect = {tuple(p): greedy_rollout(cfg, params, p, 11) for p in prompts}
+    eos = expect[(5, 9, 17)][4]  # force a mid-chunk stop for request 0
+
+    for chunk in (3, 4, 8):
+        engine = InferenceEngine(cfg, params, max_slots=4,
+                                 decode_chunk=chunk)
+        reqs = [Request(prompt_tokens=list(p), max_tokens=n,
+                        temperature=0.0, eos_id=e)
+                for p, n, e in [(prompts[0], 11, eos),
+                                (prompts[1], 7, None),
+                                (prompts[2], 11, None)]]
+        engine.generate(reqs)
+        full = expect[tuple(prompts[0])]
+        stop_at = full.index(eos) + 1 if eos in full else 11
+        assert reqs[0].output_tokens == full[:stop_at]
+        if eos in full:
+            assert reqs[0].finish_reason == "stop"
+        assert reqs[1].output_tokens == expect[tuple(prompts[1])][:7]
+        assert reqs[1].finish_reason == "length"
+        assert reqs[2].output_tokens == expect[tuple(prompts[2])]
+
+
+def test_engine_chunked_decode_capacity_bound():
+    """Out-of-room detection works on device: a chunk never writes past the
+    cache even when the request budget would keep going."""
+    cfg = dataclasses.replace(tiny_cfg(), max_seq_len=32)
+    params = init_params(cfg, jax.random.key(0))
+    engine = InferenceEngine(cfg, params, max_slots=1, max_seq_len=32,
+                             decode_chunk=8)
+    r = Request(prompt_tokens=[1, 2, 3, 4], max_tokens=100, temperature=0.0)
+    engine.generate([r])
+    assert len(r.output_tokens) == 32 - 4 + 1
+    assert r.finish_reason == "length"
+
+
+def test_engine_batched_prefill_mixed_buckets():
+    """Admissions in one tick group by length bucket; each group prefills
+    as one [rows, bucket] call, and results still match the per-request
+    greedy oracle (incl. the power-of-two row padding path: 3 real rows
+    in a rows=4 call, plus a second bucket group)."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = InferenceEngine(cfg, params, max_slots=8, prefill_budget=1024)
+    prompts = [[5, 9, 17], [3, 4], [42],                      # bucket 16
+               list(range(2, 22)), list(range(7, 25))]        # bucket 32
+    reqs = [Request(prompt_tokens=list(p), max_tokens=6, temperature=0.0)
+            for p in prompts]
+    for r in reqs:
+        engine.submit(r)
+    engine.step()  # one tick admits all five (two grouped prefill calls)
+    assert int(engine.active.sum()) == 5
+    while engine.has_work():
+        engine.step()
+    for p, r in zip(prompts, reqs):
+        assert r.output_tokens == greedy_rollout(cfg, params, p, 6), p
+
+
+def test_engine_bucketed_cache_view_parity():
+    """Decode through small cache-read views (the HBM-bandwidth
+    optimization) emits exactly what the full-cache read emits, across
+    view-bucket transitions as contexts grow."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = InferenceEngine(cfg, params, max_slots=2, decode_chunk=4)
+    assert engine.view_buckets == [64]  # tiny cap -> single bucket
+    engine.view_buckets = [16, 32, 64]  # force bucket transitions
+    prompts = [[5, 9, 17], [3, 4, 5, 6, 7, 8, 9, 10]]
+    reqs = [Request(prompt_tokens=list(p), max_tokens=30, temperature=0.0)
+            for p in prompts]
+    engine.generate(reqs)
+    for p, r in zip(prompts, reqs):
+        assert r.output_tokens == greedy_rollout(cfg, params, p, 30), p
+    # the run actually crossed view buckets (3+30+chunk > 32 > 16)
+    assert len(engine._decode_fns) >= 2
+
+
 def test_engine_prefill_budget_spreads_admission():
     """A burst of prompts is admitted over multiple steps bounded by the
     per-step prefill-token budget (bucket-padded), so in-flight decodes
