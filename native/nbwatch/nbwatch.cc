@@ -105,6 +105,16 @@ int main(int argc, char **argv) {
     closedir(d);
   }
 
+  // Announce readiness on stdout: the sync loop uses this to tell a healthy
+  // watcher on an idle pod apart from a binary that failed to exec at all.
+  {
+    std::string escaped;
+    JsonEscape(root, &escaped);
+    printf("{\"index\":-1,\"path\":\"%s\",\"op\":\"READY\"}\n",
+           escaped.c_str());
+    fflush(stdout);
+  }
+
   long index = 0;
   char buf[4096 * 4];
   for (;;) {

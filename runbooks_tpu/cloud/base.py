@@ -25,6 +25,22 @@ class BucketMount:
     read_only: bool = True
 
 
+# Where clients PUT build tarballs within an object's artifact prefix
+# (reference: internal/controller/build_reconciler.go:29).
+UPLOAD_OBJECT = "uploads/latest.tar.gz"
+
+
+@dataclasses.dataclass
+class StorageBuildContext:
+    """How a kaniko pod reads an uploaded build tarball on this cloud:
+    the --context URL plus any pod volumes/mounts the URL depends on
+    (reference: storageBuildJob per-cloud variants,
+    build_reconciler.go:405-533)."""
+    context_url: str
+    volumes: list = dataclasses.field(default_factory=list)
+    mounts: list = dataclasses.field(default_factory=list)
+
+
 class Cloud(Protocol):
     name: str
 
@@ -35,9 +51,18 @@ class Cloud(Protocol):
     def mount_bucket(self, pod_metadata: dict, pod_spec: dict, obj: Resource,
                      mount: BucketMount) -> None: ...
 
+    def storage_build_context(self, obj: Resource) -> StorageBuildContext: ...
+
     def associate_principal(self, sa: dict) -> None: ...
 
     def get_principal(self, sa: dict) -> tuple[str, bool]: ...
+
+
+def default_storage_build_context(cloud, obj: Resource) -> StorageBuildContext:
+    """For buckets kaniko fetches natively (gs://, s3://): context is the
+    bucket URL of the uploaded tarball, no extra mounts."""
+    url = cloud.object_artifact_url(obj)
+    return StorageBuildContext(context_url=f"{url}/{UPLOAD_OBJECT}")
 
 
 @dataclasses.dataclass

@@ -16,6 +16,8 @@ from runbooks_tpu.api.types import Resource
 from runbooks_tpu.cloud.base import (
     BucketMount,
     CommonConfig,
+    StorageBuildContext,
+    default_storage_build_context,
     image_name,
     image_tag_for,
     object_bucket_path,
@@ -90,6 +92,9 @@ class GCPCloud:
                 "subPath": f"{prefix}/{mount.bucket_subdir}",
                 "readOnly": mount.read_only,
             })
+
+    def storage_build_context(self, obj: Resource) -> StorageBuildContext:
+        return default_storage_build_context(self, obj)
 
     # -- identity ------------------------------------------------------
 

@@ -13,8 +13,10 @@ import dataclasses
 
 from runbooks_tpu.api.types import Resource
 from runbooks_tpu.cloud.base import (
+    UPLOAD_OBJECT,
     BucketMount,
     CommonConfig,
+    StorageBuildContext,
     image_name,
     image_tag_for,
     object_bucket_path,
@@ -67,6 +69,23 @@ class LocalCloud:
                 "mountPath": f"/content/{mount.content_subdir}",
                 "readOnly": mount.read_only,
             })
+
+    def storage_build_context(self, obj: Resource) -> StorageBuildContext:
+        """kaniko cannot fetch file:// buckets: mount the object's hostPath
+        artifact prefix at /bucket and read the tarball through the mount
+        (reference: build_reconciler.go:442-468, the kind-cloud tar://
+        hostPath arrangement)."""
+        _, rest = parse_bucket_url(self.object_artifact_url(obj))
+        return StorageBuildContext(
+            context_url=f"tar:///bucket/{UPLOAD_OBJECT}",
+            volumes=[{
+                "name": "bucket",
+                "hostPath": {"path": "/" + rest.lstrip("/"),
+                             "type": "Directory"},
+            }],
+            mounts=[{"name": "bucket", "mountPath": "/bucket",
+                     "readOnly": True}],
+        )
 
     # -- identity ------------------------------------------------------
 

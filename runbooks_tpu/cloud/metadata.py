@@ -1,0 +1,81 @@
+"""GCE metadata-server probe for cloud auto-detection.
+
+When CLOUD is unset, the controller probes the GCE metadata server to decide
+whether it is running on Google Cloud and, if so, auto-configures project /
+cluster identity from metadata attributes (reference:
+internal/cloud/cloud.go:48-85 `New()` OnGCE probe and
+internal/cloud/gcp.go:28-71 `AutoConfigure`).
+
+The probe host is overridable via GCE_METADATA_HOST (the same escape hatch
+the Google client libraries use), which is also how tests point it at a
+local HTTP fake.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.error
+import urllib.request
+
+_FLAVOR = ("Metadata-Flavor", "Google")
+
+
+def _base_url() -> str:
+    host = os.environ.get("GCE_METADATA_HOST", "metadata.google.internal")
+    return f"http://{host}/computeMetadata/v1"
+
+
+def fetch(path: str, timeout: float = 1.0) -> str:
+    """GET a metadata path (e.g. 'project/project-id'); raises on failure."""
+    req = urllib.request.Request(f"{_base_url()}/{path.lstrip('/')}")
+    req.add_header(*_FLAVOR)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode().strip()
+
+
+def _bounded(fn, timeout: float):
+    """Run fn on a worker thread with a hard deadline and return its result
+    (None on timeout/error). urlopen's timeout does NOT bound the DNS
+    lookup, so every metadata call goes through here — an off-GCP box with
+    a slow resolver must not stall controller startup."""
+    import threading
+
+    result = {}
+
+    def runner():
+        try:
+            result["v"] = fn()
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    t.join(timeout=timeout)
+    return result.get("v")
+
+
+def on_gce(timeout: float = 1.0) -> bool:
+    """True when the GCE metadata server answers with the Google flavor
+    header (the OnGCE probe; reference cloud.go:52-57)."""
+
+    def probe():
+        req = urllib.request.Request(_base_url() + "/")
+        req.add_header(*_FLAVOR)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.headers.get("Metadata-Flavor") == "Google"
+
+    return bool(_bounded(probe, timeout + 0.5))
+
+
+def auto_configure() -> dict:
+    """Metadata attributes a GKE node exposes that we need for GCPConfig
+    (reference gcp.go:28-71): project id, cluster name, cluster location.
+    Missing attributes come back as ''."""
+    out = {}
+    for key, path in (
+        ("project_id", "project/project-id"),
+        ("cluster_name", "instance/attributes/cluster-name"),
+        ("cluster_location", "instance/attributes/cluster-location"),
+    ):
+        out[key] = _bounded(lambda p=path: fetch(p), timeout=1.5) or ""
+    return out

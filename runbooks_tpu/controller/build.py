@@ -15,7 +15,7 @@ import time
 
 from runbooks_tpu.api import conditions as cond
 from runbooks_tpu.api.types import API_VERSION, KIND_TO_CLASS, Resource
-from runbooks_tpu.cloud.base import parse_bucket_url
+from runbooks_tpu.cloud.base import UPLOAD_OBJECT, parse_bucket_url
 from runbooks_tpu.controller.common import (
     FIELD_MANAGER,
     SA_CONTAINER_BUILDER,
@@ -25,7 +25,6 @@ from runbooks_tpu.controller.common import (
 from runbooks_tpu.controller.manager import Ctx, Result
 from runbooks_tpu.k8s import objects as ko
 
-UPLOAD_OBJECT = "uploads/latest.tar.gz"
 IMAGE_ANNOTATION = "runbooks-tpu.dev/target-image"
 KANIKO_IMAGE = "gcr.io/kaniko-project/executor:latest"
 GIT_IMAGE = "alpine/git:latest"
@@ -160,6 +159,7 @@ class BuildReconciler:
         ]
         init_containers = []
         volumes = [{"name": "workspace", "emptyDir": {}}]
+        kaniko_mounts = [{"name": "workspace", "mountPath": "/workspace"}]
         if git is not None:
             clone_args = ["clone", git["url"], "/workspace"]
             if git.get("branch"):
@@ -174,12 +174,12 @@ class BuildReconciler:
             context = f"dir:///workspace/{git.get('path', '').lstrip('/')}"
             kaniko_args.append(f"--context={context}")
         else:
-            bucket, prefix = self._bucket_and_prefix(ctx, obj)
-            scheme, _ = parse_bucket_url(ctx.cloud.object_artifact_url(obj))
-            ctx_scheme = {"gs": "gs", "s3": "s3",
-                          "file": "tar"}.get(scheme, scheme)
-            kaniko_args.append(
-                f"--context={ctx_scheme}://{bucket}/{prefix}/{UPLOAD_OBJECT}")
+            # How the tarball reaches kaniko is per-cloud knowledge (gs://
+            # fetched natively vs a hostPath mount locally).
+            build_ctx = ctx.cloud.storage_build_context(obj)
+            volumes.extend(build_ctx.volumes)
+            kaniko_mounts.extend(build_ctx.mounts)
+            kaniko_args.append(f"--context={build_ctx.context_url}")
 
         job = {
             "apiVersion": "batch/v1",
@@ -203,8 +203,7 @@ class BuildReconciler:
                             "name": "kaniko",
                             "image": KANIKO_IMAGE,
                             "args": kaniko_args,
-                            "volumeMounts": [{"name": "workspace",
-                                              "mountPath": "/workspace"}],
+                            "volumeMounts": kaniko_mounts,
                             "resources": {
                                 # builder sizing (reference resources.go:74-91)
                                 "requests": {"cpu": "2", "memory": "12Gi",

@@ -5,7 +5,8 @@ cloud (env CLOUD with metadata auto-detection), dial SCI over gRPC, register
 the reconcilers, serve health probes, run the watch loops.
 
 Run: ``python -m runbooks_tpu.controller.main``. Env:
-  CLOUD=local|gcp        cloud flavor (default local)
+  CLOUD=local|gcp        cloud flavor (unset: GCE metadata probe picks gcp
+                         on Google Cloud, else local)
   SCI_ADDRESS            gRPC address (default sci.runbooks-tpu.svc:10080;
                          "fake" for the in-process no-op client)
   CLUSTER_NAME, ARTIFACT_BUCKET_URL, REGISTRY_URL, PRINCIPAL
@@ -27,13 +28,28 @@ def build_ctx():
     from runbooks_tpu.controller.manager import Ctx
 
     common = CommonConfig.from_env()
-    cloud_name = os.environ.get("CLOUD", "local")
+    cloud_name = os.environ.get("CLOUD", "")
+    if not cloud_name:
+        # No explicit CLOUD: probe the GCE metadata server and auto-detect
+        # (reference: internal/cloud/cloud.go:48-85).
+        from runbooks_tpu.cloud import metadata
+
+        cloud_name = "gcp" if metadata.on_gce() else "local"
     if cloud_name == "gcp":
+        from runbooks_tpu.cloud import metadata
         from runbooks_tpu.cloud.gcp import GCPCloud, GCPConfig
 
-        cloud = GCPCloud(GCPConfig(common=common,
-                                   project_id=os.environ.get("PROJECT_ID",
-                                                             "")))
+        project_id = os.environ.get("PROJECT_ID", "")
+        cluster_location = os.environ.get("CLUSTER_LOCATION", "")
+        cluster_name_set = "CLUSTER_NAME" in os.environ
+        if not project_id or not cluster_location or not cluster_name_set:
+            auto = metadata.auto_configure()
+            project_id = project_id or auto["project_id"]
+            cluster_location = cluster_location or auto["cluster_location"]
+            if not cluster_name_set and auto["cluster_name"]:
+                common.cluster_name = auto["cluster_name"]
+        cloud = GCPCloud(GCPConfig(common=common, project_id=project_id,
+                                   cluster_location=cluster_location))
     else:
         from runbooks_tpu.cloud.local import LocalCloud
 

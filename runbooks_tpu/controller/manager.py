@@ -7,8 +7,9 @@ same shape in-process: each reconciler owns a kind; the manager feeds it
 objects from watches (or exhaustively in ``reconcile_until_stable``, the
 envtest-style test driver), and reconcilers return a Result asking for
 requeues. Dependent-object reverse lookups (Model -> Servers that reference
-it, etc.) are served by ``index_lookup`` scans instead of cached field
-indexes — correct first, cached later.
+it, etc.) are served by spec-ref scans wired into the watch loop via
+``DEPENDENT_INDEXES``: a dependency event reconciles its dependents
+immediately, matching the reference's field-index watches.
 """
 
 from __future__ import annotations
@@ -39,6 +40,19 @@ class Reconciler(Protocol):
     kind: str
 
     def reconcile(self, ctx: Ctx, obj: dict) -> Result: ...
+
+
+# Reverse dependency map: an event on the key kind requeues objects of
+# (dependent_kind, spec_ref_field) referencing it by name. This is the
+# field-index wiring of the reference (internal/controller/manager.go:23-72
+# SetupIndexes; consumed by model_controller.go:228-283 and
+# server_controller.go:83-112): a dependency flipping Ready reconciles its
+# dependents in the watch loop, not the resync poll.
+DEPENDENT_INDEXES: Dict[str, List[tuple]] = {
+    "Model": [("Server", "model"), ("Notebook", "model"),
+              ("Model", "baseModel"), ("Model", "model")],
+    "Dataset": [("Model", "dataset"), ("Notebook", "dataset")],
+}
 
 
 class Manager:
@@ -95,6 +109,10 @@ class Manager:
     def run(self, stop: threading.Event, resync_seconds: float = 30.0) -> None:
         subs = {kind: self.ctx.client.watch(API_VERSION, kind)
                 for kind in self.reconcilers}
+        # (kind, ns, name) -> monotonic due-time; the workqueue analog for
+        # Result.requeue_after (earliest-wins dedup, like controller-runtime's
+        # RateLimitingInterface).
+        pending: Dict[tuple, float] = {}
         last_resync = 0.0
         while not stop.is_set():
             worked = False
@@ -104,22 +122,22 @@ class Manager:
                     continue
                 worked = True
                 _, obj = event
-                current = self.ctx.client.get(
-                    API_VERSION, kind, ko.namespace(obj), ko.name(obj))
+                key = (kind, ko.namespace(obj), ko.name(obj))
+                current = self.ctx.client.get(API_VERSION, *key)
                 if current is None:
+                    # Deleted: dependents still need reconciling so their
+                    # gates flip (e.g. a Server loses its Model).
+                    pending.pop(key, None)
+                    self._reconcile_dependents(kind, obj, pending)
                     continue
-                from runbooks_tpu.controller.metrics import REGISTRY
-
-                for rec in self.reconcilers[kind]:
-                    try:
-                        rec.reconcile(self.ctx, current)
-                        REGISTRY.inc("controller_reconcile_total", kind=kind)
-                    except Exception:  # noqa: BLE001 — keep the loop alive
-                        import traceback
-
-                        REGISTRY.inc("controller_reconcile_errors_total",
-                                     kind=kind)
-                        traceback.print_exc()
+                self.process_event(kind, current, pending)
+            now = time.monotonic()
+            for key in [k for k, due in pending.items() if due <= now]:
+                pending.pop(key, None)
+                current = self.ctx.client.get(API_VERSION, *key)
+                if current is not None:
+                    worked = True
+                    self._reconcile_one(key[0], current, pending)
             if time.monotonic() - last_resync > resync_seconds:
                 last_resync = time.monotonic()
                 self.reconcile_until_stable(max_rounds=3,
@@ -128,15 +146,59 @@ class Manager:
             if not worked:
                 time.sleep(0.02)
 
+    def process_event(self, kind: str, obj: dict,
+                      pending: Optional[Dict[tuple, float]] = None) -> None:
+        """One watch event: reconcile the object, then fan out to its
+        dependents (DEPENDENT_INDEXES). Exposed so tests can drive the
+        watch path synchronously."""
+        self._reconcile_one(kind, obj, pending)
+        self._reconcile_dependents(kind, obj, pending)
 
-def index_lookup(client, kind: str, ref_field: str, target_name: str,
-                 namespace: str) -> List[dict]:
-    """Objects of `kind` whose spec[ref_field].name == target_name (the
-    field-index replacement; reference: internal/controller/manager.go
-    SetupIndexes)."""
-    out = []
-    for obj in client.list(API_VERSION, kind, namespace=namespace):
-        ref = ko.deep_get(obj, "spec", ref_field, default={}) or {}
-        if ref.get("name") == target_name:
-            out.append(obj)
-    return out
+    def _reconcile_one(self, kind: str, obj: dict,
+                       pending: Optional[Dict[tuple, float]] = None) -> None:
+        from runbooks_tpu.controller.metrics import REGISTRY
+
+        requeue: Optional[float] = None
+        for rec in self.reconcilers.get(kind, ()):
+            try:
+                res = rec.reconcile(self.ctx, obj)
+                REGISTRY.inc("controller_reconcile_total", kind=kind)
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                import traceback
+
+                REGISTRY.inc("controller_reconcile_errors_total", kind=kind)
+                traceback.print_exc()
+                # Errored items retry like controller-runtime's workqueue
+                # (fixed 2s here rather than exponential backoff).
+                requeue = 2.0 if requeue is None else min(requeue, 2.0)
+                continue
+            if res is None:
+                continue
+            after = 0.0 if not res.done else res.requeue_after
+            if after is not None:
+                requeue = after if requeue is None else min(requeue, after)
+        if pending is not None and requeue is not None:
+            key = (kind, ko.namespace(obj), ko.name(obj))
+            due = time.monotonic() + requeue
+            pending[key] = min(pending.get(key, due), due)
+
+    def _reconcile_dependents(self, kind: str, obj: dict,
+                              pending: Optional[Dict[tuple, float]] = None,
+                              ) -> None:
+        """Reconcile objects referencing `obj` the moment its event lands
+        (watch-driven chain advance; see DEPENDENT_INDEXES). Idempotent
+        reconcilers make the fan-out settle: a no-op reconcile writes
+        nothing, so it generates no further events. One LIST per dependent
+        kind per event (its ref fields scanned together), not one per
+        index entry — events are frequent and LISTs against a real
+        apiserver are not free."""
+        by_kind: Dict[str, List[str]] = {}
+        for dep_kind, ref_field in DEPENDENT_INDEXES.get(kind, ()):
+            if dep_kind in self.reconcilers:
+                by_kind.setdefault(dep_kind, []).append(ref_field)
+        for dep_kind, ref_fields in by_kind.items():
+            for dep in self.ctx.client.list(API_VERSION, dep_kind,
+                                            namespace=ko.namespace(obj)):
+                if any((ko.deep_get(dep, "spec", f, default={}) or {})
+                       .get("name") == ko.name(obj) for f in ref_fields):
+                    self._reconcile_one(dep_kind, dep, pending)

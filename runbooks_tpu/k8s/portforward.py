@@ -160,6 +160,13 @@ class WebSocket:
             self.send(b"", opcode=0x8)
         except OSError:
             pass
+        # shutdown before close: close() alone neither wakes a thread
+        # blocked in recv() on this socket nor sends FIN while that
+        # syscall pins the fd — the peer would hang, not see EOF.
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -250,6 +257,12 @@ class PortForwarder:
         except OSError:
             pass
         finally:
+            # shutdown first: local_to_ws may be blocked in conn.recv();
+            # a bare close() would leave it stuck and never FIN the client.
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             conn.close()
             ws.close()
 

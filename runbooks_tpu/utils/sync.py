@@ -22,8 +22,8 @@ import subprocess
 import threading
 from typing import Callable, Optional
 
-NBWATCH_LOCAL = os.path.join(os.path.dirname(__file__), "..", "..",
-                             "native", "nbwatch", "nbwatch")
+NBWATCH_DIR = os.path.join(os.path.dirname(__file__), "..", "..",
+                           "native", "nbwatch")
 NBWATCH_REMOTE = "/tmp/nbwatch"
 CONTENT_ROOT = "/content"
 
@@ -35,6 +35,49 @@ OnEvent = Callable[..., None]
 
 def _kubectl(*args: str, **kwargs):
     return subprocess.run(["kubectl", *args], check=True, **kwargs)
+
+
+def node_arch(pod: str, namespace: str) -> str:
+    """Architecture of the node running the pod, so the matching nbwatch
+    binary gets copied in (reference: internal/client/sync.go:275-293 —
+    per-arch container-tools selection from node labels)."""
+    try:
+        node = _kubectl(
+            "get", "pod", "-n", namespace, pod,
+            "-o", "jsonpath={.spec.nodeName}",
+            capture_output=True, text=True).stdout.strip()
+        if not node:
+            return ""
+        return _kubectl(
+            "get", "node", node,
+            "-o", "jsonpath={.status.nodeInfo.architecture}",
+            capture_output=True, text=True).stdout.strip()
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return ""
+
+
+def _select_nbwatch(pod: str, namespace: str) -> Optional[str]:
+    """Per-arch local binary (nbwatch-linux-{arch}, from `make -C
+    native/nbwatch release` or the release workflow); None means rely on
+    the one the workload image ships."""
+    arch = node_arch(pod, namespace)
+    candidates = []
+    if arch:
+        candidates.append(os.path.join(NBWATCH_DIR, f"nbwatch-linux-{arch}"))
+    # Un-suffixed dev build: trustworthy when the workstation is Linux (pods
+    # are) and the node arch matches — or is unknown (RBAC may forbid 'get
+    # node'); a wrong guess is surfaced by the no-READY-output check in
+    # sync_loop rather than failing silently.
+    import platform
+
+    local_arch = {"x86_64": "amd64", "aarch64": "arm64"}.get(
+        platform.machine(), platform.machine())
+    if platform.system() == "Linux" and arch in ("", local_arch):
+        candidates.append(os.path.join(NBWATCH_DIR, "nbwatch"))
+    for c in candidates:
+        if os.path.exists(c):
+            return os.path.abspath(c)
+    return None
 
 
 def copy_from_pod(pod: str, namespace: str, remote_path: str,
@@ -54,9 +97,9 @@ def sync_loop(pod: str, namespace: str, local_dir: str,
               nbwatch_path: Optional[str] = None,
               on_event: OnEvent = lambda f, c, e, r=False: None) -> None:
     """Blocking sync loop: exec nbwatch in the pod, mirror each event."""
-    binary = nbwatch_path or os.path.abspath(NBWATCH_LOCAL)
+    binary = nbwatch_path or _select_nbwatch(pod, namespace)
     try:
-        if os.path.exists(binary):
+        if binary and os.path.exists(binary):
             copy_to_pod(pod, namespace, binary, NBWATCH_REMOTE)
             _kubectl("exec", "-n", namespace, pod, "--", "chmod", "+x",
                      NBWATCH_REMOTE)
@@ -72,6 +115,7 @@ def sync_loop(pod: str, namespace: str, local_dir: str,
         on_event("", True, e, False)
         return
     assert proc.stdout is not None
+    saw_output = False
     for line in proc.stdout:
         line = line.strip()
         if not line.startswith("{"):
@@ -79,6 +123,9 @@ def sync_loop(pod: str, namespace: str, local_dir: str,
         try:
             event = json.loads(line)
         except json.JSONDecodeError:
+            continue
+        saw_output = True
+        if event.get("op") == "READY":  # nbwatch startup announcement
             continue
         rel = os.path.relpath(event["path"], CONTENT_ROOT)
         local_path = os.path.join(local_dir, rel)
@@ -93,6 +140,14 @@ def sync_loop(pod: str, namespace: str, local_dir: str,
             on_event(rel, True, None, removed)
         except subprocess.CalledProcessError as e:
             on_event(rel, True, e, removed)
+    # The watcher exiting non-zero *having produced nothing* — not even the
+    # READY announcement — means the binary was missing or the wrong format
+    # for the node; surface it instead of pretending the sync ran. A
+    # non-zero exit after READY is normal pod teardown (exec killed).
+    code = proc.wait()
+    if code != 0 and not saw_output:
+        on_event("", True, RuntimeError(
+            f"nbwatch ({watcher_cmd}) exited with code {code}"), False)
 
 
 def start_sync(pod: str, namespace: str, local_dir: str,

@@ -137,3 +137,68 @@ def test_local_cloud_hostpath_mounts():
     vol = pod_spec["volumes"][0]
     assert "hostPath" in vol
     assert pod_spec["containers"][0]["volumeMounts"][0]["readOnly"]
+
+
+def test_metadata_autodetect(monkeypatch):
+    """CLOUD unset -> GCE metadata probe decides gcp vs local, and gcp picks
+    up project/cluster identity from metadata attributes (reference:
+    internal/cloud/cloud.go:48-85, gcp.go:28-71)."""
+    import http.server
+    import threading
+
+    class FakeMetadata(http.server.BaseHTTPRequestHandler):
+        attrs = {
+            "/computeMetadata/v1/project/project-id": "proj-42",
+            "/computeMetadata/v1/instance/attributes/cluster-name": "tpu-c",
+            "/computeMetadata/v1/instance/attributes/cluster-location":
+                "us-central2-b",
+        }
+
+        def do_GET(self):  # noqa: N802
+            if self.headers.get("Metadata-Flavor") != "Google":
+                self.send_response(403)
+                self.end_headers()
+                return
+            body = self.attrs.get(self.path, "")
+            if self.path != "/computeMetadata/v1/" and not body:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Metadata-Flavor", "Google")
+            self.end_headers()
+            self.wfile.write(body.encode())
+
+        def log_message(self, *args):
+            return
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), FakeMetadata)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        host = f"127.0.0.1:{srv.server_address[1]}"
+        monkeypatch.setenv("GCE_METADATA_HOST", host)
+        monkeypatch.delenv("CLOUD", raising=False)
+        monkeypatch.delenv("PROJECT_ID", raising=False)
+        monkeypatch.delenv("CLUSTER_NAME", raising=False)
+        monkeypatch.setenv("ARTIFACT_BUCKET_URL", "gs://b")
+        monkeypatch.setenv("SCI_ADDRESS", "fake")
+        monkeypatch.setenv("STANDALONE", "1")
+
+        from runbooks_tpu.controller.main import build_ctx
+
+        ctx = build_ctx()
+        assert ctx.cloud.name == "gcp"
+        assert ctx.cloud.config.project_id == "proj-42"
+        assert ctx.cloud.config.common.cluster_name == "tpu-c"
+        assert ctx.cloud.config.cluster_location == "us-central2-b"
+
+        # Probe failure -> local (point at a closed port).
+        srv2 = http.server.HTTPServer(("127.0.0.1", 0), FakeMetadata)
+        port2 = srv2.server_address[1]
+        srv2.server_close()
+        monkeypatch.setenv("GCE_METADATA_HOST", f"127.0.0.1:{port2}")
+        ctx = build_ctx()
+        assert ctx.cloud.name == "local"
+    finally:
+        srv.shutdown()
+        srv.server_close()

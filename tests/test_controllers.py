@@ -93,6 +93,35 @@ def test_build_upload_handshake_and_job(harness):
                       "container-builder") is not None
 
 
+def test_build_storage_job_mounts_local_bucket(harness):
+    """CLOUD=local storage builds mount the hostPath artifact prefix into
+    the kaniko pod and read the tarball through the mount — otherwise
+    kaniko has no way to reach a file:// bucket on a real kind cluster
+    (reference: build_reconciler.go:442-468)."""
+    client, cloud, sci, mgr = harness
+    m = Model.new("mb", spec={
+        "build": {"upload": {"md5checksum": "feed01", "requestID": "r9"}}})
+    client.create(m.obj)
+    mgr.reconcile_until_stable()
+    bucket, obj_name = sci.signed[-1][0], sci.signed[-1][1]
+    sci.objects[f"{bucket}/{obj_name}"] = "feed01"
+    mgr.reconcile_until_stable()
+
+    job = client.get("batch/v1", "Job", "default", "mb-model-bld")
+    assert job is not None
+    pod = job["spec"]["template"]["spec"]
+    vols = {v["name"]: v for v in pod["volumes"]}
+    assert "bucket" in vols and "hostPath" in vols["bucket"]
+    host_path = vols["bucket"]["hostPath"]["path"]
+    from runbooks_tpu.cloud.base import parse_bucket_url
+    _, rest = parse_bucket_url(cloud.object_artifact_url(m))
+    assert host_path == "/" + rest.lstrip("/")
+    kaniko = pod["containers"][0]
+    assert {"name": "bucket", "mountPath": "/bucket",
+            "readOnly": True} in kaniko["volumeMounts"]
+    assert "--context=tar:///bucket/uploads/latest.tar.gz" in kaniko["args"]
+
+
 def test_build_git_job_args(harness):
     client, cloud, sci, mgr = harness
     m = Model.new("m2", spec={
@@ -270,6 +299,74 @@ def test_server_lifecycle(harness):
     mgr.reconcile_until_stable()
     cur = Server(get(client, "Server", "srv"))
     assert cur.ready and cur.condition_true(cond.SERVING)
+
+
+def test_dependent_requeue_on_model_event(harness):
+    """A Model watch event fans out to Servers referencing it (the
+    field-index requeue; reference: internal/controller/manager.go:23-72,
+    server_controller.go:83-112) — no resync involved at any point."""
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("wm", spec={"image": "loader"}).obj)
+    client.create(Server.new("ws", spec={
+        "image": "server-img", "model": {"name": "wm"}}).obj)
+
+    # Initial events: modeller Job created, Server gated on model readiness.
+    mgr.process_event("Model", get(client, "Model", "wm"))
+    mgr.process_event("Server", get(client, "Server", "ws"))
+    assert client.get("batch/v1", "Job", "default", "wm-modeller") is not None
+    assert client.get("apps/v1", "Deployment", "default", "ws") is None
+
+    # Job completes; the resulting Model event both readies the Model and
+    # fans out to the Server, which creates its Deployment immediately.
+    client.mark_job_complete("default", "wm-modeller")
+    mgr.process_event("Model", get(client, "Model", "wm"))
+    assert Model(get(client, "Model", "wm")).ready
+    assert client.get("apps/v1", "Deployment", "default", "ws") is not None
+
+    # Deployment becomes available; the next Model event (any event on the
+    # dependency requeues dependents) flips the Server to Serving.
+    client.mark_deployment_ready("default", "ws")
+    mgr.process_event("Model", get(client, "Model", "wm"))
+    cur = Server(get(client, "Server", "ws"))
+    assert cur.ready and cur.condition_true(cond.SERVING)
+
+
+def test_watch_loop_advances_chain_without_resync(harness):
+    """Manager.run with resync effectively disabled: the Model->Server chain
+    advances via watch events + requeue_after scheduling alone."""
+    import threading
+    import time
+
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("lm", spec={"image": "loader"}).obj)
+    client.create(Server.new("ls", spec={
+        "image": "server-img", "model": {"name": "lm"}}).obj)
+
+    stop = threading.Event()
+    t = threading.Thread(target=mgr.run, args=(stop,),
+                         kwargs={"resync_seconds": 3600.0}, daemon=True)
+    t.start()
+
+    def wait_for(pred, timeout=10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        return pred()
+
+    try:
+        assert wait_for(lambda: client.get(
+            "batch/v1", "Job", "default", "lm-modeller") is not None)
+        client.mark_job_complete("default", "lm-modeller")
+        assert wait_for(lambda: client.get(
+            "apps/v1", "Deployment", "default", "ls") is not None)
+        client.mark_deployment_ready("default", "ls")
+        assert wait_for(
+            lambda: Server(get(client, "Server", "ls")).ready)
+    finally:
+        stop.set()
+        t.join(timeout=5)
 
 
 def test_server_requires_model(harness):

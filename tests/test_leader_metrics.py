@@ -101,7 +101,7 @@ def test_run_with_leader_election_gates_reconciling():
             self.runs = 0
             self.running = threading.Event()
 
-        def run(self, stop_event):
+        def run(self, stop_event, **kwargs):
             self.runs += 1
             self.running.set()
             stop_event.wait(timeout=10)

@@ -164,7 +164,14 @@ def test_port_forward_error_channel_closes_connection():
                        local_port=0, remote_port=9000,
                        on_ready=lambda p: (bound.update(port=p),
                                            ready.set()))
-    threading.Thread(target=pf.serve, daemon=True).start()
+
+    def serve_expecting_error():
+        # serve() re-raising the apiserver error is the designed exit here;
+        # the assertions below read it from pf._error.
+        with pytest.raises(ConnectionError):
+            pf.serve()
+
+    threading.Thread(target=serve_expecting_error, daemon=True).start()
     assert ready.wait(timeout=30)
     with socket.create_connection(("127.0.0.1", bound["port"]), 30) as c:
         c.settimeout(30)
