@@ -59,10 +59,18 @@ def inner() -> None:
         overrides["flash_block_q"] = int(os.environ["RBT_BENCH_BQ"])
     if os.environ.get("RBT_BENCH_BK"):
         overrides["flash_block_k"] = int(os.environ["RBT_BENCH_BK"])
+    # State-memory levers (BENCH_NOTES r3: f32 masters + moments are the
+    # 5 GB forcing full remat). RBT_BENCH_PARAM_DTYPE=bfloat16 +
+    # RBT_BENCH_MU_DTYPE=bfloat16 + RBT_BENCH_REMAT=save_attn_out is the
+    # staged path from 0.442 toward the ~0.6 estimated ceiling.
+    if os.environ.get("RBT_BENCH_PARAM_DTYPE"):
+        overrides["param_dtype"] = os.environ["RBT_BENCH_PARAM_DTYPE"]
 
     cfg = get_config(model, **overrides)
     mesh = single_device_mesh()
-    opt = make_optimizer(OptimizerConfig(total_steps=10_000, warmup_steps=10))
+    opt = make_optimizer(OptimizerConfig(
+        total_steps=10_000, warmup_steps=10,
+        mu_dtype=os.environ.get("RBT_BENCH_MU_DTYPE") or None))
     state, shardings = create_train_state(cfg, opt, mesh, jax.random.key(0))
     step = make_train_step(cfg, opt, mesh, shardings)
 

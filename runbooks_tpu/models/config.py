@@ -96,8 +96,11 @@ class ModelConfig:
 
     # Training-time behavior. "nothing_saveable" = full remat (memory-safe
     # default); "dots_saveable" / "dots_with_no_batch_dims_saveable" save
-    # matmul outputs; "none" disables remat entirely (all activations
-    # saved — single-chip HBM-rich configs only).
+    # matmul outputs; "save_attn_out" saves only the named per-layer
+    # attention output (skips the O(s^2) attention recompute in bwd at
+    # O(L*b*s*h) bf16 cost — the selective middle ground); "none" disables
+    # remat entirely (all activations saved — single-chip HBM-rich configs
+    # only).
     remat_policy: str = "nothing_saveable"
 
     # Pipeline parallelism: microbatches per step when the mesh has a

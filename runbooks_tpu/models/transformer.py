@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from runbooks_tpu.models.config import ModelConfig
 from runbooks_tpu.ops.attention import (
@@ -427,6 +428,13 @@ def _block(cfg: ModelConfig, layer: Params, x, positions, segment_ids, mask,
     attn_out, new_cache = _attention_block(
         cfg, layer["attn"], h1, positions, segment_ids, mask, bias,
         layer_cache)
+    # Named checkpoint for selective remat: remat_policy="save_attn_out"
+    # saves this [b, s, h] tensor (plus the flash kernel's hoisted
+    # "attn_context"/"attn_lse" residuals — see ops/flash_attention.py) so
+    # the backward never re-runs the O(s^2) flash fwd kernel, while
+    # activations stay O(layers * b * s * h) instead of the dots_saveable
+    # blow-up.
+    attn_out = checkpoint_name(attn_out, "attn_out")
     if cfg.parallel_block:
         h2 = h1 if cfg.shared_layer_norm else _norm(cfg, layer["ln2"], x)
         mlp_out, aux = _ffn_block(cfg, layer, h2)
@@ -727,6 +735,18 @@ def _remat_policy(name: str):
         "dots_saveable": jax.checkpoint_policies.dots_saveable,
         "dots_with_no_batch_dims_saveable":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # Selective: save the per-layer attention outputs — the post-wo
+        # "attn_out" tagged in _block plus the flash kernel's hoisted
+        # residuals "attn_context"/"attn_lse" (ops/flash_attention.py) —
+        # and remat everything else. On the flash path the backward then
+        # feeds the dq/dkv kernels from saved residuals instead of
+        # re-running the O(s^2) fwd kernel (verified: the recompute pallas
+        # call disappears from the grad jaxpr); on the xla path the s^2
+        # einsum residuals are not nameable at O(s) memory, so this is
+        # ~nothing_saveable plus a saved wo output there.
+        "save_attn_out":
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_context", "attn_lse"),
     }
     if name not in policies:
         raise ValueError(

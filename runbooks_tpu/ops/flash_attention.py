@@ -29,6 +29,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -370,7 +371,6 @@ def _bwd_dkv_kernel(q_pos_ref, kv_pos_ref, q_seg_ref, kv_seg_ref,
 # Public op with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
 def flash_attention(
     q: jax.Array,                      # [b, sq, h, d]
     k: jax.Array,                      # [b, sk, kv_h, d] (kv_h divides h)
@@ -391,25 +391,47 @@ def flash_attention(
     layout, including contiguous packing). Offset layouts (e.g. a chunked
     prefill where q rows start at position P > 0) violate this; the skip
     auto-disables when sq != sk, and callers with aligned lengths but
-    misaligned positions must pass block_skip=False."""
-    out, _ = _flash_fwd(
+    misaligned positions must pass block_skip=False.
+
+    Structure: the fwd kernel runs OUTSIDE the custom_vjp, and its outputs
+    (out, lse) — exactly the backward kernels' residuals — enter the vjp as
+    stop_gradient'ed arguments tagged with checkpoint_name. Residuals
+    nested inside a custom_vjp fwd are invisible to jax.checkpoint
+    policies (verified: names in a vjp-fwd don't change compiled FLOPs);
+    hoisting them to the caller's trace level makes
+    remat_policy="save_attn_out" actually skip the O(s^2) fwd-kernel
+    recompute in the backward pass instead of only the wo projection."""
+    scale_v = scale if scale is not None else q.shape[-1] ** -0.5
+    # Inputs are stop_gradient'ed so linearization treats this residual-
+    # producing kernel as a constant (the pallas call has no JVP rule);
+    # the differentiable path runs through _flash_core's custom vjp, whose
+    # q/k/v args carry the real tangents.
+    out, lse = _flash_fwd(
+        jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
+        jax.lax.stop_gradient(v), q_positions, kv_positions,
+        q_segment_ids, kv_segment_ids,
+        scale_v, causal, block_q, block_k, block_skip)
+    out = checkpoint_name(out, "attn_context")
+    lse = checkpoint_name(lse, "attn_lse")
+    return _flash_core(
         q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids,
-        scale if scale is not None else q.shape[-1] ** -0.5, causal,
-        block_q, block_k, block_skip)
+        out, lse, causal, scale_v, block_q, block_k, block_skip)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
+def _flash_core(q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse,
+                causal, scale, block_q, block_k, block_skip):
     return out
 
 
-def _vjp_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg,
+def _vjp_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse,
              causal, scale, block_q, block_k, block_skip):
-    scale_v = scale if scale is not None else q.shape[-1] ** -0.5
-    out, lse = _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg,
-                          scale_v, causal, block_q, block_k, block_skip)
     return out, (q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse)
 
 
 def _vjp_bwd(causal, scale, block_q, block_k, block_skip, res, g):
     q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse = res
-    scale_v = scale if scale is not None else q.shape[-1] ** -0.5
+    scale_v = scale  # always concrete: flash_attention resolves None
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kv_h = k.shape[2]
@@ -552,7 +574,11 @@ def _vjp_bwd(causal, scale, block_q, block_k, block_skip, res, g):
     dv = dv.reshape(b, kv_h, n_rep, sk_p, d).sum(axis=2)[:, :, :sk]
     dk = jnp.swapaxes(dk, 1, 2).astype(k.dtype)
     dv = jnp.swapaxes(dv, 1, 2).astype(v.dtype)
-    return dq, dk, dv, None, None, None, None
+    # Zero cotangents for the hoisted residual args (out, lse): the real
+    # attention gradient routes entirely through q/k/v, and the producers
+    # are stop_gradient'ed at the call site so these zeros are dropped.
+    return (dq, dk, dv, None, None, None, None,
+            jnp.zeros_like(out), jnp.zeros_like(lse))
 
 
-flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+_flash_core.defvjp(_vjp_fwd, _vjp_bwd)

@@ -25,6 +25,12 @@ class OptimizerConfig:
     eps: float = 1e-8
     weight_decay: float = 0.0
     grad_clip_norm: Optional[float] = 1.0
+    # Dtype for the adam first moment (mu). None keeps the param dtype
+    # (f32 masters -> f32 mu). "bfloat16" halves mu bytes — measured on the
+    # bench-410m shapes the f32 masters+moments are the 5 GB that force
+    # full remat (BENCH_NOTES r3); bf16 mu is the first of the three
+    # state-memory levers (mu dtype, param dtype, state sharding).
+    mu_dtype: Optional[str] = None
 
 
 def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
@@ -51,6 +57,7 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
             learning_rate=make_schedule(cfg),
             b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
             weight_decay=cfg.weight_decay,
+            mu_dtype=cfg.mu_dtype,
         )
     )
     return optax.chain(*chain)

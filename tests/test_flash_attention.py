@@ -167,3 +167,45 @@ def test_gradients_kv_longer_than_q_causal():
     for a, b_, name in zip(gf, gr, "qkv"):
         np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-4,
                                    err_msg=f"d{name}")
+
+
+def _count_pallas_calls(jaxpr, n=0):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            n += 1
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                n = _count_pallas_calls(v.jaxpr, n)
+            elif hasattr(v, "eqns"):
+                n = _count_pallas_calls(v, n)
+    return n
+
+
+def test_save_attn_out_skips_fwd_kernel_recompute():
+    """remat_policy="save_attn_out" must eliminate the O(s^2) fwd-kernel
+    re-run in the backward pass: the kernel's residuals (out, lse) are
+    hoisted to the caller's trace level (ops/flash_attention.py) exactly so
+    the checkpoint policy can save them. nothing_saveable: fwd x2 (primal +
+    recompute) + dq + dkv = 4 pallas calls; save_attn_out: 3."""
+    import dataclasses
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import forward, init_params
+
+    base = dataclasses.replace(
+        get_config("debug"), attention_impl="flash",
+        flash_block_q=64, flash_block_k=64)
+    tokens = jnp.zeros((1, 128), jnp.int32)
+    counts = {}
+    for policy in ("nothing_saveable", "save_attn_out"):
+        cfg = dataclasses.replace(base, remat_policy=policy)
+        params = init_params(cfg, jax.random.key(0))
+
+        def loss(p, cfg=cfg):
+            logits, _ = forward(cfg, p, tokens, remat=True)
+            return jnp.mean(logits)
+
+        jaxpr = jax.make_jaxpr(jax.grad(loss))(params)
+        counts[policy] = _count_pallas_calls(jaxpr.jaxpr)
+    assert counts["nothing_saveable"] == 4, counts
+    assert counts["save_attn_out"] == 3, counts

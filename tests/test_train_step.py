@@ -76,6 +76,34 @@ def test_mesh_layouts_agree_numerically():
         np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
 
 
+def test_bf16_masters_and_mu_dtype():
+    # The state-memory levers (BENCH_NOTES r3: f32 masters + adam moments
+    # are the 5 GB forcing full remat): bf16 master params + bf16 mu must
+    # produce a train step that runs, shards, and still learns.
+    cfg = dataclasses.replace(tiny_cfg(), param_dtype="bfloat16",
+                              dtype="bfloat16")
+    mesh = make_mesh(MeshConfig(data=1, fsdp=8, sequence=1, tensor=1))
+    opt = make_optimizer(OptimizerConfig(
+        learning_rate=1e-2, warmup_steps=0, total_steps=100,
+        schedule="constant", mu_dtype="bfloat16"))
+    state, shardings = create_train_state(cfg, opt, mesh, jax.random.key(0))
+
+    assert all(x.dtype == jnp.bfloat16
+               for x in jax.tree.leaves(state.params))
+    adam_state = state.opt_state[1][0]  # (clip, adamw(scale_by_adam, ...))
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(adam_state.mu))
+
+    step = make_train_step(cfg, opt, mesh, shardings)
+    batch = make_batch(cfg)
+    losses = []
+    with jax.set_mesh(mesh):
+        for _ in range(4):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
 def test_fsdp_actually_shards_params():
     cfg = tiny_cfg()
     mesh = make_mesh(MeshConfig(data=1, fsdp=8, sequence=1, tensor=1))

@@ -113,6 +113,33 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(l1, l2, rtol=1e-6, atol=1e-6)
 
 
+def test_save_attn_out_policy_matches_full_remat():
+    # The selective policy (save only the named attn_out tensor) must not
+    # change numerics — forward or gradients — vs full remat and no remat.
+    cfg = tiny("llama2-7b")
+    sel = dataclasses.replace(cfg, remat_policy="save_attn_out")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+
+    def loss(c, p):
+        logits, _ = forward(c, p, tokens, remat=True)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 0])
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(cfg, p))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss(sel, p))(params)
+    np.testing.assert_allclose(l1, l2, rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_unknown_remat_policy_raises():
+    cfg = dataclasses.replace(tiny("llama2-7b"), remat_policy="bogus")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab_size)
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        forward(cfg, params, tokens, remat=True)
+
+
 def test_bf16_forward_close_to_fp32():
     cfg32 = tiny("llama2-7b")
     cfg16 = dataclasses.replace(cfg32, dtype="bfloat16")
