@@ -20,17 +20,60 @@ import urllib.request
 _FLAVOR = ("Metadata-Flavor", "Google")
 
 
-def _base_url() -> str:
-    host = os.environ.get("GCE_METADATA_HOST", "metadata.google.internal")
+def _hosts() -> list:
+    override = os.environ.get("GCE_METADATA_HOST")
+    if override:
+        return [override]
+    # Try the DNS name AND the literal address, like the Go metadata
+    # client: a transient DNS hiccup at pod start must not make a GKE
+    # controller look off-cloud.
+    return ["metadata.google.internal", "169.254.169.254"]
+
+
+def _base_url(host: str) -> str:
     return f"http://{host}/computeMetadata/v1"
 
 
+# Sentinel: the metadata server answered 404 — the attribute does not
+# exist (e.g. GKE instance attributes on a plain GCE VM). Distinct from
+# "no host reachable", which is a connectivity failure worth crash-looping
+# over.
+_ABSENT = object()
+
+
+def _fetch_raw(path: str, timeout: float = 1.0):
+    """Try each metadata host with its OWN bounded window (the _bounded
+    deadline must cover a hanging DNS lookup on host 1 without starving
+    the literal-IP fallback). Returns the value, _ABSENT on 404, or None
+    when no host answered."""
+    for host in _hosts():
+        def one(h=host):
+            req = urllib.request.Request(
+                f"{_base_url(h)}/{path.lstrip('/')}")
+            req.add_header(*_FLAVOR)
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.read().decode().strip()
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return _ABSENT
+                raise
+
+        value = _bounded(one, timeout + 0.5)
+        if value is not None:
+            return value
+    return None
+
+
 def fetch(path: str, timeout: float = 1.0) -> str:
-    """GET a metadata path (e.g. 'project/project-id'); raises on failure."""
-    req = urllib.request.Request(f"{_base_url()}/{path.lstrip('/')}")
-    req.add_header(*_FLAVOR)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return resp.read().decode().strip()
+    """GET a metadata path (e.g. 'project/project-id'); raises on failure
+    (OSError: unreachable; LookupError: server answered but path absent)."""
+    value = _fetch_raw(path, timeout)
+    if value is None:
+        raise OSError(f"GCE metadata server unreachable fetching {path}")
+    if value is _ABSENT:
+        raise LookupError(f"GCE metadata attribute absent: {path}")
+    return value
 
 
 def _bounded(fn, timeout: float):
@@ -54,28 +97,60 @@ def _bounded(fn, timeout: float):
     return result.get("v")
 
 
-def on_gce(timeout: float = 1.0) -> bool:
+def on_gce(timeout: float = 1.0, attempts: int = 3) -> bool:
     """True when the GCE metadata server answers with the Google flavor
-    header (the OnGCE probe; reference cloud.go:52-57)."""
+    header (the OnGCE probe; reference cloud.go:52-57). Probes both the
+    DNS name and the literal 169.254.169.254, with retries — a single-shot
+    1s probe failing on a transient hiccup must not misclassify the
+    environment (r4 advisor, medium)."""
 
-    def probe():
-        req = urllib.request.Request(_base_url() + "/")
-        req.add_header(*_FLAVOR)
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.headers.get("Metadata-Flavor") == "Google"
+    def probe_host(host):
+        def probe():
+            req = urllib.request.Request(_base_url(host) + "/")
+            req.add_header(*_FLAVOR)
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.headers.get("Metadata-Flavor") == "Google"
 
-    return bool(_bounded(probe, timeout + 0.5))
+        return _bounded(probe, timeout + 0.5)
+
+    for attempt in range(attempts):
+        for host in _hosts():
+            if probe_host(host):
+                return True
+        if attempt < attempts - 1:
+            import time
+
+            time.sleep(0.2 * (attempt + 1))
+    return False
 
 
-def auto_configure() -> dict:
+def auto_configure(needed=("project_id", "cluster_name",
+                           "cluster_location")) -> dict:
     """Metadata attributes a GKE node exposes that we need for GCPConfig
     (reference gcp.go:28-71): project id, cluster name, cluster location.
-    Missing attributes come back as ''."""
-    out = {}
-    for key, path in (
-        ("project_id", "project/project-id"),
-        ("cluster_name", "instance/attributes/cluster-name"),
-        ("cluster_location", "instance/attributes/cluster-location"),
-    ):
-        out[key] = _bounded(lambda p=path: fetch(p), timeout=1.5) or ""
+    Fetches ONLY the `needed` keys — the caller passes what its env did
+    not provide, so an off-GCE CLOUD=gcp deployment missing just the
+    optional cluster name never touches the project-id path.
+
+    project_id (when needed) is required: unreachable-or-absent raises
+    RuntimeError, mirroring the reference's AutoConfigure error returns —
+    a not-yet-ready metadata server must crash-loop the controller until
+    it answers, not let it proceed with empty project identity (r4
+    advisor). The GKE-only instance attributes (cluster-name/-location)
+    come back as '' when unreachable or 404: a plain GCE VM / off-GCE box
+    with env-provided identity is not an error."""
+    paths = {
+        "project_id": "project/project-id",
+        "cluster_name": "instance/attributes/cluster-name",
+        "cluster_location": "instance/attributes/cluster-location",
+    }
+    out = {k: "" for k in paths}
+    for key in needed:
+        value = _fetch_raw(paths[key], timeout=1.0)
+        if key == "project_id" and (
+                value is None or value is _ABSENT or not value):
+            raise RuntimeError(
+                "failed to get project id from the GCE metadata server "
+                f"({paths[key]}); set PROJECT_ID or fix node metadata")
+        out[key] = "" if (value is None or value is _ABSENT) else value
     return out

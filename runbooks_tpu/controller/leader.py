@@ -43,6 +43,7 @@ class LeaderElector:
         self.renew_s = renew_s
         self.is_leader = threading.Event()
         self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
 
     def _try_acquire(self) -> bool:
         lease = self.client.get(LEASE_API, "Lease", self.namespace, self.name)
@@ -65,7 +66,9 @@ class LeaderElector:
         holder = spec.get("holderIdentity")
         renew = _parse(spec.get("renewTime", ""))
         expired = time.time() - renew > self.lease_duration_s
-        if holder != self.identity and not expired:
+        # An empty holderIdentity is an explicitly released lease (see
+        # release()): free for the taking regardless of renewTime.
+        if holder and holder != self.identity and not expired:
             return False
         spec.update({"holderIdentity": self.identity, "renewTime": now})
         try:
@@ -89,8 +92,36 @@ class LeaderElector:
                 self._stop.wait(self.renew_s)
 
         thread = threading.Thread(target=loop, daemon=True)
+        self._thread = thread
         thread.start()
         return thread
 
     def stop(self) -> None:
         self._stop.set()
+
+    def release(self) -> None:
+        """Stop renewing AND hand the lease back (holderIdentity cleared)
+        so a standby can take over immediately instead of waiting out
+        lease_duration_s. Called when the manager dies unexpectedly — a
+        crashed leader must not stay leader on paper."""
+        self.stop()
+        # The renewal loop may be mid-_try_acquire; were the lease cleared
+        # now, that in-flight renewal could re-write holderIdentity after
+        # us — a dead leader holding a freshly renewed lease. Join the
+        # loop first so the clear is the last word.
+        if self._thread is not None:
+            self._thread.join(timeout=self.renew_s * 4 + 5)
+        self.is_leader.clear()
+        try:
+            lease = self.client.get(LEASE_API, "Lease", self.namespace,
+                                    self.name)
+            if lease and lease.get("spec", {}).get("holderIdentity") == \
+                    self.identity:
+                # Keep renewTime a valid MicroTime — a real apiserver
+                # rejects "" for the field; the empty holderIdentity alone
+                # marks the lease released (_try_acquire treats it as free).
+                lease["spec"].update({"holderIdentity": "",
+                                      "renewTime": _now()})
+                self.client.update(lease)
+        except Exception:  # noqa: BLE001 — best-effort; expiry still works
+            pass

@@ -30,11 +30,27 @@ def build_ctx():
     common = CommonConfig.from_env()
     cloud_name = os.environ.get("CLOUD", "")
     if not cloud_name:
-        # No explicit CLOUD: probe the GCE metadata server and auto-detect
-        # (reference: internal/cloud/cloud.go:48-85).
+        # No explicit CLOUD: probe the GCE metadata server (with retries +
+        # literal-host fallback) and auto-detect. A failed/negative probe
+        # is fatal like the reference (cloud.go:60-68 "unable to determine
+        # cloud"): silently coming up as the local cloud on real GKE would
+        # misreconcile every object with hostPath buckets and a
+        # localhost registry (r4 advisor, medium). STANDALONE demo mode
+        # (in-memory cluster, nothing real to damage) still defaults to
+        # local.
         from runbooks_tpu.cloud import metadata
 
-        cloud_name = "gcp" if metadata.on_gce() else "local"
+        if os.environ.get("STANDALONE"):
+            # Demo mode (in-memory cluster, nothing real to damage): one
+            # quick probe, local on failure — don't pay the full retry
+            # ladder off-cloud where both hosts can black-hole.
+            cloud_name = "gcp" if metadata.on_gce(attempts=1) else "local"
+        elif metadata.on_gce():
+            cloud_name = "gcp"
+        else:
+            raise RuntimeError(
+                "unable to determine cloud: the GCE metadata probe did "
+                "not answer; set CLOUD=gcp|local explicitly")
     if cloud_name == "gcp":
         from runbooks_tpu.cloud import metadata
         from runbooks_tpu.cloud.gcp import GCPCloud, GCPConfig
@@ -42,12 +58,35 @@ def build_ctx():
         project_id = os.environ.get("PROJECT_ID", "")
         cluster_location = os.environ.get("CLUSTER_LOCATION", "")
         cluster_name_set = "CLUSTER_NAME" in os.environ
-        if not project_id or not cluster_location or not cluster_name_set:
-            auto = metadata.auto_configure()
+        needed = [k for k, have in (
+            ("project_id", project_id),
+            ("cluster_location", cluster_location),
+            ("cluster_name", cluster_name_set),
+        ) if not have]
+        if needed:
+            # Raises when project_id is needed and unavailable; the
+            # optional cluster attributes tolerate absence.
+            auto = metadata.auto_configure(needed)
             project_id = project_id or auto["project_id"]
             cluster_location = cluster_location or auto["cluster_location"]
             if not cluster_name_set and auto["cluster_name"]:
                 common.cluster_name = auto["cluster_name"]
+        # Zero-config GKE: derive the artifact endpoints from the project
+        # identity when env vars are unset (reference gcp.go:56-69), using
+        # the same names install/gcp-up.sh provisions. Without these,
+        # startup "succeeded" but every reconcile failed on
+        # parse_bucket_url('') (r4 advisor).
+        region = cluster_location
+        if region.count("-") >= 2:  # zone like us-central2-b -> region
+            region = region.rsplit("-", 1)[0]
+        if not common.registry_url and region and project_id:
+            common.registry_url = (
+                f"{region}-docker.pkg.dev/{project_id}/runbooks-tpu")
+        if not common.artifact_bucket_url and project_id:
+            common.artifact_bucket_url = f"gs://{project_id}-runbooks-tpu"
+        if not common.principal and project_id:
+            common.principal = (
+                f"runbooks-tpu@{project_id}.iam.gserviceaccount.com")
         cloud = GCPCloud(GCPConfig(common=common, project_id=project_id,
                                    cluster_location=cluster_location))
     else:
@@ -109,7 +148,16 @@ def run_with_leader_election(mgr, elector, stop, poll_s: float = 0.5,
                 leader_stop.set()
 
             threading.Thread(target=watch_leadership, daemon=True).start()
-            mgr.run(leader_stop, resync_seconds=resync_seconds)
+            try:
+                mgr.run(leader_stop, resync_seconds=resync_seconds)
+            except BaseException:
+                # The manager died while we hold the lease. Hand the lease
+                # back so a standby takes over immediately, then re-raise
+                # to crash the process (restart-and-rejoin) — the one thing
+                # that must never happen is a dead leader renewing its
+                # lease forever (r4 verdict, Weak #2).
+                elector.release()
+                raise
 
 
 class _Health(BaseHTTPRequestHandler):

@@ -75,7 +75,20 @@ class Manager:
         for round_no in range(1, max_rounds + 1):
             changed = False
             for kind, recs in self.reconcilers.items():
-                for obj in self.ctx.client.list(API_VERSION, kind):
+                # The LIST/GET against the apiserver can fail transiently
+                # (connection refused/reset during an apiserver restart).
+                # With raise_errors=False that must not escape — one failed
+                # LIST killing the resync path killed the whole manager
+                # thread while the leader lease kept renewing (r4 verdict).
+                try:
+                    objs = list(self.ctx.client.list(API_VERSION, kind))
+                except Exception:  # noqa: BLE001
+                    if raise_errors:
+                        raise
+                    self._log_apiserver_error(f"list {kind}")
+                    changed = True  # retry next round, don't claim stable
+                    continue
+                for obj in objs:
                     before = (ko.deep_get(obj, "metadata", "resourceVersion"),)
                     for rec in recs:
                         try:
@@ -91,8 +104,15 @@ class Manager:
                             REGISTRY.inc("controller_reconcile_errors_total",
                                          kind=kind)
                             traceback.print_exc()
-                    after_obj = self.ctx.client.get(
-                        API_VERSION, kind, ko.namespace(obj), ko.name(obj))
+                    try:
+                        after_obj = self.ctx.client.get(
+                            API_VERSION, kind, ko.namespace(obj), ko.name(obj))
+                    except Exception:  # noqa: BLE001
+                        if raise_errors:
+                            raise
+                        self._log_apiserver_error(f"get {kind}")
+                        changed = True  # unknown outcome: don't claim stable
+                        continue
                     if after_obj is None:
                         changed = True
                         continue
@@ -104,47 +124,94 @@ class Manager:
                 return round_no
         return max_rounds
 
+    @staticmethod
+    def _log_apiserver_error(what: str) -> None:
+        import sys
+        import traceback
+
+        from runbooks_tpu.controller.metrics import REGISTRY
+
+        REGISTRY.inc("controller_apiserver_errors_total")
+        err = sys.exc_info()[1]
+        print(f"manager: apiserver error during {what} (will retry): "
+              f"{err!r}", flush=True)
+        if not isinstance(err, (ConnectionError, OSError)):
+            traceback.print_exc()
+
     # -- watch-driven loop (deployment path) ---------------------------
 
-    def run(self, stop: threading.Event, resync_seconds: float = 30.0) -> None:
-        subs = {kind: self.ctx.client.watch(API_VERSION, kind)
-                for kind in self.reconcilers}
+    def run(self, stop: threading.Event, resync_seconds: float = 30.0,
+            max_backoff: float = 30.0) -> None:
+        """Watch-driven loop. Survives apiserver failure: any transient
+        error (refused/reset connections on watch, GET, or dependent LIST)
+        logs, backs off exponentially, re-subscribes the watches, and keeps
+        going — matching controller-runtime's retry semantics. Before r5
+        one unguarded LIST killed this thread while the leader lease kept
+        renewing (a dead leader that looked alive)."""
+        subs: Dict[str, object] = {}
+
+        def close_subs() -> None:
+            # Old subscriptions must be closed, not just dropped: the wire
+            # client's reader thread reconnects forever and its queue keeps
+            # filling — one leaked thread + queue per apiserver hiccup.
+            for sub in subs.values():
+                close = getattr(sub, "close", None)
+                if close is not None:
+                    close()
+            subs.clear()
+
         # (kind, ns, name) -> monotonic due-time; the workqueue analog for
         # Result.requeue_after (earliest-wins dedup, like controller-runtime's
         # RateLimitingInterface).
         pending: Dict[tuple, float] = {}
         last_resync = 0.0
+        backoff = 0.5
         while not stop.is_set():
-            worked = False
-            for kind, sub in subs.items():
-                event = sub.poll(timeout=0.05)
-                if event is None:
-                    continue
-                worked = True
-                _, obj = event
-                key = (kind, ko.namespace(obj), ko.name(obj))
-                current = self.ctx.client.get(API_VERSION, *key)
-                if current is None:
-                    # Deleted: dependents still need reconciling so their
-                    # gates flip (e.g. a Server loses its Model).
-                    pending.pop(key, None)
-                    self._reconcile_dependents(kind, obj, pending)
-                    continue
-                self.process_event(kind, current, pending)
-            now = time.monotonic()
-            for key in [k for k, due in pending.items() if due <= now]:
-                pending.pop(key, None)
-                current = self.ctx.client.get(API_VERSION, *key)
-                if current is not None:
+            try:
+                if not subs:
+                    subs = {kind: self.ctx.client.watch(API_VERSION, kind)
+                            for kind in self.reconcilers}
+                worked = False
+                for kind, sub in subs.items():
+                    event = sub.poll(timeout=0.05)
+                    if event is None:
+                        continue
                     worked = True
-                    self._reconcile_one(key[0], current, pending)
-            if time.monotonic() - last_resync > resync_seconds:
-                last_resync = time.monotonic()
-                self.reconcile_until_stable(max_rounds=3,
-                                            raise_errors=False)
-                worked = True
-            if not worked:
-                time.sleep(0.02)
+                    _, obj = event
+                    key = (kind, ko.namespace(obj), ko.name(obj))
+                    current = self.ctx.client.get(API_VERSION, *key)
+                    if current is None:
+                        # Deleted: dependents still need reconciling so
+                        # their gates flip (e.g. a Server loses its Model).
+                        pending.pop(key, None)
+                        self._reconcile_dependents(kind, obj, pending)
+                        continue
+                    self.process_event(kind, current, pending)
+                now = time.monotonic()
+                for key in [k for k, due in pending.items() if due <= now]:
+                    pending.pop(key, None)
+                    current = self.ctx.client.get(API_VERSION, *key)
+                    if current is not None:
+                        worked = True
+                        self._reconcile_one(key[0], current, pending)
+                if time.monotonic() - last_resync > resync_seconds:
+                    last_resync = time.monotonic()
+                    self.reconcile_until_stable(max_rounds=3,
+                                                raise_errors=False)
+                    worked = True
+                backoff = 0.5  # healthy iteration: reset
+                if not worked:
+                    time.sleep(0.02)
+            except Exception:  # noqa: BLE001 — apiserver down: retry
+                self._log_apiserver_error("watch loop")
+                # Old subscriptions may be dead after an apiserver restart;
+                # close them so the next iteration re-subscribes, and the
+                # resync re-lists everything missed while down.
+                close_subs()
+                last_resync = 0.0
+                stop.wait(backoff)
+                backoff = min(backoff * 2, max_backoff)
+        close_subs()
 
     def process_event(self, kind: str, obj: dict,
                       pending: Optional[Dict[tuple, float]] = None) -> None:
@@ -174,7 +241,12 @@ class Manager:
                 continue
             if res is None:
                 continue
-            after = 0.0 if not res.done else res.requeue_after
+            # done=False means "requeue now" — but through a floor, not a
+            # 0.0s due-time: an always-not-done reconciler would otherwise
+            # busy-spin GET+reconcile against the apiserver (controller-
+            # runtime routes immediate requeues through the rate-limited
+            # workqueue for the same reason).
+            after = 0.5 if not res.done else res.requeue_after
             if after is not None:
                 requeue = after if requeue is None else min(requeue, after)
         if pending is not None and requeue is not None:

@@ -19,6 +19,7 @@ import ssl
 import tempfile
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -279,7 +280,20 @@ class K8sClient:
 
             last_log = -1e9
             resource_version = ""
-            while True:
+            split = urllib.parse.urlsplit(self.config.server)
+            preflight_addr = (split.hostname,
+                              split.port or (443 if split.scheme == "https"
+                                             else 80))
+            # urlopen may route through an HTTP(S) proxy; a direct TCP
+            # preflight would then fail even though requests work. Only
+            # preflight when the connection is direct.
+            try:
+                proxied = (split.scheme in urllib.request.getproxies()
+                           and not urllib.request.proxy_bypass(
+                               split.hostname or ""))
+            except OSError:
+                proxied = False
+            while not sub.closed.is_set():
                 query = "watch=true&allowWatchBookmarks=true"
                 if resource_version:
                     query += f"&resourceVersion={resource_version}"
@@ -288,30 +302,55 @@ class K8sClient:
                     url, headers={**self.config.headers,
                                   "Accept": "application/json"})
                 try:
+                    # Cheap TCP preflight with a short timeout: a
+                    # black-holed apiserver must not pin this thread inside
+                    # a long urlopen connect where close() is invisible —
+                    # the manager re-subscribes per backoff cycle and would
+                    # stack such threads.
+                    if not proxied:
+                        import socket as _socket
+
+                        _socket.create_connection(preflight_addr,
+                                                  timeout=5).close()
+                        if sub.closed.is_set():
+                            return
                     # Socket read timeout bounds half-open connections; the
                     # apiserver sends bookmarks well inside this window.
                     with urllib.request.urlopen(
                             req, context=self.config.ssl_ctx,
                             timeout=300) as resp:
-                        for line in resp:
-                            if not line.strip():
-                                continue
-                            event = json.loads(line)
-                            obj = event.get("object", {})
-                            rv = ko.deep_get(obj, "metadata",
-                                             "resourceVersion")
-                            if rv:
-                                resource_version = rv
-                            etype = event.get("type", "MODIFIED")
-                            if etype == "ERROR":
-                                # e.g. 410 Gone: resourceVersion expired —
-                                # restart from now (manager resync covers
-                                # the gap).
-                                resource_version = ""
-                                break
-                            if etype == "BOOKMARK":
-                                continue
-                            sub.put(etype, obj)
+                        if sub.closed.is_set():
+                            return
+                        # close() must interrupt a blocked body read, not
+                        # wait out the 300s timeout: register the response
+                        # so closing it from the closer thread errors the
+                        # read (caught below as a reconnect).
+                        sub.add_closer(resp.close)
+                        try:
+                            for line in resp:
+                                if sub.closed.is_set():
+                                    return
+                                if not line.strip():
+                                    continue
+                                event = json.loads(line)
+                                obj = event.get("object", {})
+                                rv = ko.deep_get(obj, "metadata",
+                                                 "resourceVersion")
+                                if rv:
+                                    resource_version = rv
+                                etype = event.get("type", "MODIFIED")
+                                if etype == "ERROR":
+                                    # e.g. 410 Gone: resourceVersion
+                                    # expired — restart from now (manager
+                                    # resync covers the gap).
+                                    resource_version = ""
+                                    break
+                                if etype == "BOOKMARK":
+                                    continue
+                                sub.put(etype, obj)
+                        finally:
+                            # Don't accumulate a stale closer per reconnect.
+                            sub.remove_closer(resp.close)
                 except Exception as e:  # noqa: BLE001 — reconnect loop
                     # Rate-limit the reconnect log: a dead apiserver (or a
                     # test server that shut down) would otherwise spam a
@@ -321,7 +360,8 @@ class K8sClient:
                         last_log = now
                         print(f"watch {kind}: reconnecting after {e!r}",
                               file=sys.stderr)
-                    time.sleep(2)
+                    if sub.closed.wait(2):
+                        return
 
         threading.Thread(target=reader, daemon=True).start()
         return sub

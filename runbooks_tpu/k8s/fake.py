@@ -60,13 +60,48 @@ def _merge(dst: Any, src: Any) -> Any:
 
 
 class Subscription:
-    """A watch stream: iterate or poll events ("ADDED"/"MODIFIED"/"DELETED")."""
+    """A watch stream: iterate or poll events ("ADDED"/"MODIFIED"/"DELETED").
+
+    close() ends the stream: puts become no-ops (the queue stops growing)
+    and the wire client's reader thread exits its reconnect loop — without
+    it, a manager re-subscribing after apiserver failure would leak one
+    forever-reconnecting thread plus an undrained queue per hiccup."""
 
     def __init__(self):
         self.q: "queue.Queue[Tuple[str, Obj]]" = queue.Queue()
+        self.closed = threading.Event()
+        self._closers: List = []
 
     def put(self, event: str, obj: Obj) -> None:
-        self.q.put((event, ko.clone(obj)))
+        if not self.closed.is_set():
+            self.q.put((event, ko.clone(obj)))
+
+    def add_closer(self, fn) -> None:
+        """Register a callback run at close() — the wire reader registers
+        its in-flight HTTP response so close() interrupts a blocked body
+        read instead of waiting out the socket timeout."""
+        if self.closed.is_set():
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        self._closers.append(fn)
+
+    def remove_closer(self, fn) -> None:
+        try:
+            self._closers.remove(fn)
+        except ValueError:
+            pass
+
+    def close(self) -> None:
+        self.closed.set()
+        closers, self._closers = self._closers, []
+        for fn in closers:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                pass
 
     def poll(self, timeout: float = 0.0):
         try:
@@ -222,7 +257,14 @@ class FakeCluster:
                           if s is not sub]
 
     def _notify(self, event: str, obj: Obj) -> None:
-        for av, k, sub in self._subs:
+        # Prune closed subscriptions as a side effect: callers close() subs
+        # without necessarily unwatch()ing (the manager's error-path
+        # re-subscribe), and dead entries must not accumulate.
+        live = [(av, k, s) for (av, k, s) in self._subs
+                if not s.closed.is_set()]
+        if len(live) != len(self._subs):
+            self._subs = live
+        for av, k, sub in live:
             if (av is None or av == ko.api_version(obj)) and \
                     (k is None or k == ko.kind(obj)):
                 sub.put(event, obj)

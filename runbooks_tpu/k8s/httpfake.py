@@ -62,7 +62,8 @@ def _parse_path(path: str) -> Optional[dict]:
 class FakeApiServer:
     """Threaded HTTP server over a FakeCluster. Use as a context manager."""
 
-    def __init__(self, cluster: Optional[FakeCluster] = None):
+    def __init__(self, cluster: Optional[FakeCluster] = None,
+                 port: int = 0):
         self.cluster = cluster or FakeCluster()
         self.requests: List[Tuple[str, str, str, str]] = []  # m, p, q, ct
         shim = self
@@ -187,7 +188,9 @@ class FakeApiServer:
 
             do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _route
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        # A fixed port lets tests restart the "apiserver" at the same
+        # address (manager crash-recovery coverage).
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
 

@@ -65,17 +65,21 @@ class GCPSCI:
         env = os.environ
         project = env.get("PROJECT_ID", "")
         if not project:
-            import urllib.request
+            # Shared dual-host (DNS name + literal IP), deadline-bounded
+            # metadata fetch — a hanging resolver must not stall SCI
+            # startup any more than controller startup (cloud/metadata.py).
+            from runbooks_tpu.cloud import metadata
 
-            req = urllib.request.Request(
-                "http://metadata.google.internal/computeMetadata/v1/"
-                "project/project-id",
-                headers={"Metadata-Flavor": "Google"})
             last_err: Exception | None = None
             for attempt in range(5):  # workload-identity warm-up races
                 try:
-                    project = urllib.request.urlopen(
-                        req, timeout=3).read().decode()
+                    project = metadata.fetch("project/project-id",
+                                             timeout=3.0)
+                    break
+                except LookupError as e:
+                    # Server answered 404: deterministic absence — no
+                    # amount of retrying heals it.
+                    last_err = e
                     break
                 except OSError as e:
                     last_err = e

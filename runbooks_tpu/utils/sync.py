@@ -143,9 +143,14 @@ def sync_loop(pod: str, namespace: str, local_dir: str,
     # The watcher exiting non-zero *having produced nothing* — not even the
     # READY announcement — means the binary was missing or the wrong format
     # for the node; surface it instead of pretending the sync ran. A
-    # non-zero exit after READY is normal pod teardown (exec killed).
+    # non-zero exit after READY is normal pod teardown (exec killed). In
+    # the image-binary path also tolerate silent SIGKILL/SIGTERM exits
+    # (137/143): an image-shipped nbwatch predating the READY announcement,
+    # killed at pod teardown before any file event, is not a failure
+    # (r4 advisor).
     code = proc.wait()
-    if code != 0 and not saw_output:
+    if code != 0 and not saw_output and not (
+            watcher_cmd != NBWATCH_REMOTE and code in (137, 143)):
         on_event("", True, RuntimeError(
             f"nbwatch ({watcher_cmd}) exited with code {code}"), False)
 

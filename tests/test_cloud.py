@@ -192,13 +192,35 @@ def test_metadata_autodetect(monkeypatch):
         assert ctx.cloud.config.common.cluster_name == "tpu-c"
         assert ctx.cloud.config.cluster_location == "us-central2-b"
 
-        # Probe failure -> local (point at a closed port).
+        # Probe failure (closed port): STANDALONE demo mode falls back to
+        # local; otherwise it is fatal like the reference (cloud.go:60-68)
+        # — silently coming up local on real GKE misreconciles everything.
         srv2 = http.server.HTTPServer(("127.0.0.1", 0), FakeMetadata)
         port2 = srv2.server_address[1]
         srv2.server_close()
         monkeypatch.setenv("GCE_METADATA_HOST", f"127.0.0.1:{port2}")
         ctx = build_ctx()
         assert ctx.cloud.name == "local"
+        monkeypatch.delenv("STANDALONE")
+        with pytest.raises(RuntimeError, match="unable to determine cloud"):
+            build_ctx()
+        monkeypatch.setenv("STANDALONE", "1")
+
+        # A reachable metadata server missing required attributes is also
+        # fatal (auto_configure must not return '' project ids).
+        class Empty(FakeMetadata):
+            attrs = {}
+
+        srv3 = http.server.HTTPServer(("127.0.0.1", 0), Empty)
+        threading.Thread(target=srv3.serve_forever, daemon=True).start()
+        try:
+            monkeypatch.setenv("GCE_METADATA_HOST",
+                               f"127.0.0.1:{srv3.server_address[1]}")
+            with pytest.raises(RuntimeError, match="failed to get project"):
+                build_ctx()
+        finally:
+            srv3.shutdown()
+            srv3.server_close()
     finally:
         srv.shutdown()
         srv.server_close()

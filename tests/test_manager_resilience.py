@@ -1,0 +1,137 @@
+"""Manager/leader behavior under apiserver failure.
+
+r4 verdict, Weak #2: one failed LIST killed the manager thread while the
+leader lease kept renewing — a dead leader that looked alive. These tests
+kill the wire apiserver mid-run and assert the manager resumes, and kill
+the manager under leader election and assert a standby takes over
+immediately (lease released, not waited out).
+"""
+
+import ssl
+import threading
+import time
+
+import pytest
+
+from runbooks_tpu.api.types import API_VERSION
+from runbooks_tpu.controller.leader import LEASE_API, LeaderElector
+from runbooks_tpu.controller.main import run_with_leader_election
+from runbooks_tpu.controller.manager import Ctx, Manager, Result
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.k8s.client import K8sClient, KubeConfig
+from runbooks_tpu.k8s.fake import FakeCluster
+from runbooks_tpu.k8s.httpfake import FakeApiServer
+
+
+class Recorder:
+    kind = "Model"
+
+    def __init__(self):
+        self.seen = []
+
+    def reconcile(self, ctx, obj):
+        self.seen.append(ko.name(obj))
+        return Result()
+
+
+def model(name):
+    return {"apiVersion": API_VERSION, "kind": "Model",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"image": "img"}}
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return cond()
+
+
+def test_manager_survives_apiserver_restart():
+    cluster = FakeCluster()
+    srv = FakeApiServer(cluster)
+    srv.__enter__()
+    port = int(srv.url.rsplit(":", 1)[1])
+    client = K8sClient(KubeConfig(srv.url, ssl.create_default_context(), {}))
+
+    rec = Recorder()
+    mgr = Manager(Ctx(client=client, cloud=None, sci=None), [rec])
+    stop = threading.Event()
+    t = threading.Thread(target=mgr.run, args=(stop,),
+                         kwargs={"resync_seconds": 0.3, "max_backoff": 0.5},
+                         daemon=True)
+    t.start()
+    try:
+        client.create(model("m1"))
+        assert _wait(lambda: "m1" in rec.seen), "manager never reconciled m1"
+
+        # Apiserver dies. The manager loop must keep running (log+backoff),
+        # not die with an unhandled URLError out of a LIST/watch.
+        srv.__exit__()
+        time.sleep(1.0)
+        assert t.is_alive(), "manager thread died while apiserver was down"
+
+        # Apiserver comes back at the SAME address with the same objects
+        # plus a new one created while the manager reconnects.
+        srv2 = FakeApiServer(cluster, port=port)
+        srv2.__enter__()
+        try:
+            client.create(model("m2"))
+            assert _wait(lambda: "m2" in rec.seen), (
+                "manager did not resume reconciling after apiserver restart")
+            assert t.is_alive()
+        finally:
+            srv2.__exit__()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_standby_takes_over_immediately_when_manager_dies():
+    client = FakeCluster()
+    leader = LeaderElector(client, lease_duration_s=30.0, renew_s=0.05)
+    leader.run()
+    assert leader.is_leader.wait(timeout=3)
+    standby = LeaderElector(client, lease_duration_s=30.0, renew_s=0.05)
+    standby.run()
+    time.sleep(0.3)
+    assert not standby.is_leader.is_set()
+
+    class Boom:
+        def run(self, stop, resync_seconds=30.0):
+            raise RuntimeError("manager exploded")
+
+    # The leader's manager dies: run_with_leader_election must release the
+    # lease (standby takes over well before the 30s lease duration) and
+    # re-raise so the process crashes and restarts.
+    with pytest.raises(RuntimeError, match="manager exploded"):
+        run_with_leader_election(Boom(), leader, stop=threading.Event(),
+                                 poll_s=0.05)
+    assert standby.is_leader.wait(timeout=5), (
+        "standby did not take over after the leader's manager died")
+    lease = client.get(LEASE_API, "Lease", standby.namespace, standby.name)
+    assert lease["spec"]["holderIdentity"] == standby.identity
+    standby.stop()
+
+
+def test_done_false_requeues_through_a_floor():
+    """Result(done=False) must requeue with a floor, not a 0.0s due-time
+    (an always-not-done reconciler would busy-spin the apiserver)."""
+
+    class NotDone:
+        kind = "Model"
+
+        def reconcile(self, ctx, obj):
+            return Result(done=False)
+
+    cluster = FakeCluster()
+    mgr = Manager(Ctx(client=cluster, cloud=None, sci=None), [NotDone()])
+    obj = cluster.create(model("m1"))
+    pending = {}
+    t0 = time.monotonic()
+    mgr._reconcile_one("Model", obj, pending)
+    key = ("Model", "default", "m1")
+    assert key in pending
+    assert pending[key] - t0 >= 0.4, "immediate requeue has no floor"
