@@ -81,6 +81,14 @@ class ModelConfig:
     flash_block_q: int = 512
     flash_block_k: int = 1024
 
+    # Ring attention's per-step inner kernel. None = auto: the Pallas
+    # flash kernel per rotated K/V block on TPU (out/lse merge forward, a
+    # hand-written second ring pass backward — parallel/ring_attention.py),
+    # the XLA einsum path elsewhere. Without the flash inner a
+    # sequence-parallel mesh pays the HBM-materialized-scores cost that
+    # flash exists to avoid (measured 0.10-0.23 vs 0.44 MFU single-chip).
+    ring_flash_inner: Optional[bool] = None
+
     # Embedding lookup as one-hot matmul instead of gather. Under a
     # tensor-sharded vocab, GSPMD partitions the matmul cleanly where the
     # gather forces an involuntary full-remat reshard. Measured on the

@@ -280,7 +280,11 @@ def _dispatch_attention(cfg: ModelConfig, q, k, v, positions, segment_ids,
             True, None, cfg.flash_block_q, cfg.flash_block_k)
 
     if impl == "ring":
-        from runbooks_tpu.parallel.ring_attention import ring_attention
+        from runbooks_tpu.parallel.ring_attention import (
+            ring_attention,
+            ring_flash_attention_sharded,
+            use_flash_inner_default,
+        )
         from runbooks_tpu.parallel.sharding import (
             _current_mesh, spec_for_array)
 
@@ -296,6 +300,18 @@ def _dispatch_attention(cfg: ModelConfig, q, k, v, positions, segment_ids,
         rspec = spec_for_array(positions.shape, ("batch", "seq"), mesh)
         seg = (segment_ids if segment_ids is not None
                else jnp.ones_like(positions))
+
+        use_flash = cfg.ring_flash_inner
+        if use_flash is None:
+            use_flash = use_flash_inner_default()
+        if use_flash:
+            lse_spec = spec_for_array(
+                (q.shape[0], q.shape[2], q.shape[1]),
+                ("batch", "act_heads", "seq"), mesh)
+            return ring_flash_attention_sharded(
+                q, k, v, positions, seg, mesh, qspec, kspec, rspec,
+                lse_spec, block_q=cfg.flash_block_q,
+                block_k=cfg.flash_block_k)
 
         def local(ql, kl, vl, pl_, sl):
             return ring_attention(ql, kl, vl, pl_, pl_, sl, sl,

@@ -59,15 +59,22 @@ def _bcast_sublanes(x):  # [b, s] -> [b, SUBLANES, s]
                                     (0, 2))
 
 
-def _interpret() -> bool:
-    # Compile via Mosaic only on real TPU backends (PJRT plugin backends may
-    # report a vendor name rather than "tpu" — check the device too).
+def is_tpu_backend() -> bool:
+    """Shared TPU detection: PJRT plugin backends may report a vendor name
+    rather than "tpu", so check the device string too. Used both for the
+    Mosaic-vs-interpret choice here and for ring attention's auto inner —
+    the two must agree or a TPU could silently get the slow XLA ring."""
     if "tpu" in jax.default_backend().lower():
-        return False
-    try:
-        return "TPU" not in str(jax.devices()[0])
-    except RuntimeError:
         return True
+    try:
+        return "TPU" in str(jax.devices()[0])
+    except RuntimeError:
+        return False
+
+
+def _interpret() -> bool:
+    # Compile via Mosaic only on real TPU backends.
+    return not is_tpu_backend()
 
 
 # ---------------------------------------------------------------------------
@@ -159,8 +166,27 @@ def _pad_to(x, size, axis, value=0):
     return jnp.pad(x, widths, constant_values=value)
 
 
+def flash_fwd_qside(q, q_pos, q_seg, block_q):
+    """Query-side kernel prep (layout transpose + padded lane broadcasts),
+    split out so ring attention can hoist it OUT of its per-K/V-block scan
+    — it is invariant across ring steps and XLA does not reliably hoist it
+    from a while-loop body."""
+    b, sq, h, d = q.shape
+    bq = min(block_q, sq)
+    sq_p = pl.cdiv(sq, bq) * bq
+    # Layout [b, h, s, d] for kernel-friendly blocking. Padding queries
+    # produce garbage rows that are sliced off.
+    qT = _pad_to(jnp.swapaxes(q, 1, 2), sq_p, 2)
+    q_pos_p = _pad_to(q_pos.astype(jnp.int32), sq_p, 1, value=0)
+    use_segments = q_seg is not None
+    q_seg_p = (_pad_to(q_seg.astype(jnp.int32), sq_p, 1, value=0)
+               if use_segments else jnp.zeros_like(q_pos_p))
+    return (qT, _bcast_lanes(q_pos_p), _bcast_lanes(q_seg_p), use_segments)
+
+
 def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
-               block_q, block_k, block_skip=True):
+               block_q, block_k, block_skip=True, out_dtype=None,
+               qside=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kv_h = k.shape[2]
@@ -170,22 +196,16 @@ def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
     sq_p = pl.cdiv(sq, block_q) * block_q
     sk_p = pl.cdiv(sk, block_k) * block_k
 
-    # Layout [b, h, s, d] for kernel-friendly blocking.
-    qT = _pad_to(jnp.swapaxes(q, 1, 2), sq_p, 2)
+    if qside is None:
+        qside = flash_fwd_qside(q, q_pos, q_seg, block_q)
+    qT, q_pos_l, q_seg_l, use_segments = qside
     kT = _pad_to(jnp.swapaxes(k, 1, 2), sk_p, 2)
     vT = _pad_to(jnp.swapaxes(v, 1, 2), sk_p, 2)
     # Padding keys get segment 0 + positions beyond any query so that causal
-    # and segment masks both kill them. Padding queries produce garbage rows
-    # that are sliced off.
-    q_pos_p = _pad_to(q_pos.astype(jnp.int32), sq_p, 1, value=0)
+    # and segment masks both kill them.
     kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), sk_p, 1, value=PAD_POS)
-    use_segments = q_seg is not None
-    if use_segments:
-        q_seg_p = _pad_to(q_seg.astype(jnp.int32), sq_p, 1, value=0)
-        kv_seg_p = _pad_to(kv_seg.astype(jnp.int32), sk_p, 1, value=0)
-    else:
-        q_seg_p = jnp.zeros_like(q_pos_p)
-        kv_seg_p = jnp.zeros_like(kv_pos_p)
+    kv_seg_p = (_pad_to(kv_seg.astype(jnp.int32), sk_p, 1, value=0)
+                if use_segments else jnp.zeros_like(kv_pos_p))
 
     grid = (b, h, sq_p // block_q, sk_p // block_k)
     # Grid-index skip is only exact when q index i and kv index i carry the
@@ -237,7 +257,7 @@ def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((b, h, sq_p, LANES), jnp.float32),
         ],
         scratch_shapes=[
@@ -246,8 +266,8 @@ def _flash_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale, causal,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(_bcast_lanes(q_pos_p), _bcast_sublanes(kv_pos_p),
-      _bcast_lanes(q_seg_p), _bcast_sublanes(kv_seg_p), qT, kT, vT)
+    )(q_pos_l, _bcast_sublanes(kv_pos_p),
+      q_seg_l, _bcast_sublanes(kv_seg_p), qT, kT, vT)
 
     out = jnp.swapaxes(out[:, :, :sq], 1, 2)          # [b, sq, h, d]
     return out, lse[:, :, :sq, 0]
@@ -431,7 +451,56 @@ def _vjp_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse,
 
 def _vjp_bwd(causal, scale, block_q, block_k, block_skip, res, g):
     q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse = res
-    scale_v = scale  # always concrete: flash_attention resolves None
+    dq, dk, dv = flash_attention_bwd(
+        q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse, g,
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        block_skip=block_skip)
+    # Zero cotangents for the hoisted residual args (out, lse): the real
+    # attention gradient routes entirely through q/k/v, and the producers
+    # are stop_gradient'ed at the call site so these zeros are dropped.
+    return (dq, dk, dv, None, None, None, None,
+            jnp.zeros_like(out), jnp.zeros_like(lse))
+
+
+def flash_bwd_qside(q, g, out, lse, q_pos, q_seg, block_q):
+    """Query-side backward prep: the delta reduction and the lane-broadcast
+    [b, h, sq_p, LANES] f32 lse/delta buffers (see layout note at top of
+    file) plus padded q/do transposes. Invariant across ring steps — ring
+    attention hoists this out of its backward scan so the (n-1)-step ring
+    pays the delta reduction and 128x broadcasts once, not per step."""
+    b, sq, h, d = q.shape
+    bq = min(block_q, sq)
+    sq_p = pl.cdiv(sq, bq) * bq
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                # [b, sq, h]
+    deltaT = jax.lax.broadcast_in_dim(
+        _pad_to(jnp.swapaxes(delta, 1, 2), sq_p, 2),
+        (b, h, sq_p, LANES), (0, 1, 2))
+    lseT = jax.lax.broadcast_in_dim(
+        _pad_to(lse, sq_p, 2, value=NEG_INF),
+        (b, h, sq_p, LANES), (0, 1, 2))
+    qT = _pad_to(jnp.swapaxes(q, 1, 2), sq_p, 2)
+    doT = _pad_to(jnp.swapaxes(g, 1, 2), sq_p, 2)
+    q_pos_p = _pad_to(q_pos.astype(jnp.int32), sq_p, 1, value=-(2**30))
+    use_segments = q_seg is not None
+    q_seg_p = (_pad_to(q_seg.astype(jnp.int32), sq_p, 1, value=0)
+               if use_segments else jnp.zeros_like(q_pos_p))
+    return (qT, doT, lseT, deltaT, _bcast_lanes(q_pos_p),
+            _bcast_lanes(q_seg_p), use_segments)
+
+
+def flash_attention_bwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, out, lse, g,
+                        *, causal, scale, block_q, block_k, block_skip,
+                        grad_dtype=None, qside=None):
+    """Backward kernels (dq, dkv) given the GLOBAL (out, lse) for these
+    queries. Besides serving flash_attention's vjp, this is the per-block
+    building block of ring attention's backward pass: with global lse the
+    per-block probabilities exp(s - lse) are exact global-softmax slices,
+    so summing block dq (and ring-accumulating dk/dv) is the exact
+    gradient (parallel/ring_attention.py). grad_dtype overrides the
+    gradient dtype (ring accumulates partial grads in f32 across steps);
+    qside takes a precomputed flash_bwd_qside result."""
+    scale_v = scale  # always concrete: callers resolve None
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kv_h = k.shape[2]
@@ -441,33 +510,16 @@ def _vjp_bwd(causal, scale, block_q, block_k, block_skip, res, g):
     sq_p = pl.cdiv(sq, block_q) * block_q
     sk_p = pl.cdiv(sk, block_k) * block_k
 
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                                # [b, sq, h]
-    # lse/delta are per-q-row; carried lane-broadcast [b, h, sq_p, LANES]
-    # to satisfy Mosaic block tiling (see layout note at top of file).
-    deltaT = jax.lax.broadcast_in_dim(
-        _pad_to(jnp.swapaxes(delta, 1, 2), sq_p, 2),
-        (b, h, sq_p, LANES), (0, 1, 2))
-    lseT = jax.lax.broadcast_in_dim(
-        _pad_to(lse, sq_p, 2, value=NEG_INF),
-        (b, h, sq_p, LANES), (0, 1, 2))
-    qT = _pad_to(jnp.swapaxes(q, 1, 2), sq_p, 2)
+    if qside is None:
+        qside = flash_bwd_qside(q, g, out, lse, q_pos, q_seg, block_q)
+    qT, doT, lseT, deltaT, q_pos_l, q_seg_l, use_segments = qside
     kT = _pad_to(jnp.swapaxes(k, 1, 2), sk_p, 2)
     vT = _pad_to(jnp.swapaxes(v, 1, 2), sk_p, 2)
-    doT = _pad_to(jnp.swapaxes(g, 1, 2), sq_p, 2)
-    q_pos_p = _pad_to(q_pos.astype(jnp.int32), sq_p, 1, value=-(2**30))
     kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), sk_p, 1, value=PAD_POS)
-    use_segments = q_seg is not None
-    if use_segments:
-        q_seg_p = _pad_to(q_seg.astype(jnp.int32), sq_p, 1, value=0)
-        kv_seg_p = _pad_to(kv_seg.astype(jnp.int32), sk_p, 1, value=0)
-    else:
-        q_seg_p = jnp.zeros_like(q_pos_p)
-        kv_seg_p = jnp.zeros_like(kv_pos_p)
+    kv_seg_p = (_pad_to(kv_seg.astype(jnp.int32), sk_p, 1, value=0)
+                if use_segments else jnp.zeros_like(kv_pos_p))
 
-    q_pos_l = _bcast_lanes(q_pos_p)
     kv_pos_s = _bcast_sublanes(kv_pos_p)
-    q_seg_l = _bcast_lanes(q_seg_p)
     kv_seg_s = _bcast_sublanes(kv_seg_p)
 
     skip = bool(block_skip and causal and sq == sk)  # see _flash_fwd note
@@ -515,7 +567,8 @@ def _vjp_bwd(causal, scale, block_q, block_k, block_skip, res, g):
             pl.BlockSpec((1, 1, block_q, LANES), hq),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), hq),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d),
+                                       grad_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q_pos_l, kv_pos_s, q_seg_l, kv_seg_s, qT, kT, vT, doT, lseT, deltaT)
@@ -557,8 +610,8 @@ def _vjp_bwd(causal, scale, block_q, block_k, block_skip, res, g):
             pl.BlockSpec((1, 1, block_k, d), hk2_write),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), grad_dtype or k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), grad_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -572,13 +625,9 @@ def _vjp_bwd(causal, scale, block_q, block_k, block_skip, res, g):
     # each kv head (sum over the query heads sharing it).
     dk = dk.reshape(b, kv_h, n_rep, sk_p, d).sum(axis=2)[:, :, :sk]
     dv = dv.reshape(b, kv_h, n_rep, sk_p, d).sum(axis=2)[:, :, :sk]
-    dk = jnp.swapaxes(dk, 1, 2).astype(k.dtype)
-    dv = jnp.swapaxes(dv, 1, 2).astype(v.dtype)
-    # Zero cotangents for the hoisted residual args (out, lse): the real
-    # attention gradient routes entirely through q/k/v, and the producers
-    # are stop_gradient'ed at the call site so these zeros are dropped.
-    return (dq, dk, dv, None, None, None, None,
-            jnp.zeros_like(out), jnp.zeros_like(lse))
+    dk = jnp.swapaxes(dk, 1, 2).astype(grad_dtype or k.dtype)
+    dv = jnp.swapaxes(dv, 1, 2).astype(grad_dtype or v.dtype)
+    return dq, dk, dv
 
 
 _flash_core.defvjp(_vjp_fwd, _vjp_bwd)

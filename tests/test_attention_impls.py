@@ -82,3 +82,95 @@ def test_ring_impl_gradients_match():
     for a, b in zip(jax.tree.leaves(gx), jax.tree.leaves(gr)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_ring_flash_inner_matches_xla_inner():
+    """SPxflash composition (r4 verdict #5): the flash-kernel-per-block
+    ring (out/lse merge fwd, hand-written ring bwd with global lse) must
+    match the autodiff XLA-inner ring and the single-device oracle."""
+    cfg_x = cfg_with("xla")
+    cfg_rf = dataclasses.replace(cfg_with("ring"), ring_flash_inner=True,
+                                 flash_block_q=16, flash_block_k=16)
+    params = init_params(cfg_x, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg_x.vocab_size)
+    mesh = make_mesh(MeshConfig(data=2, fsdp=1, sequence=4, tensor=1))
+
+    lx, _ = forward(cfg_x, params, toks)
+    with jax.set_mesh(mesh):
+        lr = jax.jit(lambda p, t: forward(cfg_rf, p, t)[0])(params, toks)
+    np.testing.assert_allclose(lx, np.asarray(lr), rtol=2e-4, atol=2e-4)
+
+
+def test_ring_flash_inner_gradients_match():
+    cfg_x = cfg_with("xla")
+    cfg_rf = dataclasses.replace(cfg_with("ring"), ring_flash_inner=True,
+                                 flash_block_q=16, flash_block_k=16)
+    params = init_params(cfg_x, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg_x.vocab_size)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, sequence=4, tensor=2))
+
+    def loss(cfg):
+        def inner(params):
+            logits, _ = forward(cfg, params, toks)
+            return jnp.mean(jax.nn.log_softmax(logits) ** 2)
+        return inner
+
+    gx = jax.grad(loss(cfg_x))(params)
+    with jax.set_mesh(mesh):
+        gr = jax.jit(jax.grad(loss(cfg_rf)))(params)
+    for a, b in zip(jax.tree.leaves(gx), jax.tree.leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_ring_flash_inner_with_packing():
+    """Packed segments cross shard boundaries; the flash inner must mask
+    identically to the XLA inner under rotation."""
+    cfg_r = cfg_with("ring")
+    cfg_rf = dataclasses.replace(cfg_r, ring_flash_inner=True,
+                                 flash_block_q=16, flash_block_k=16)
+    params = init_params(cfg_r, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg_r.vocab_size)
+    segs = jnp.asarray(np.repeat([[1, 2, 3, 0]], 16, axis=1).reshape(1, 64)
+                       .repeat(2, 0))
+    pos = jnp.asarray(np.tile(np.arange(16), 4)[None].repeat(2, 0),
+                      jnp.int32)
+    mesh = make_mesh(MeshConfig(data=2, fsdp=1, sequence=4, tensor=1))
+    with jax.set_mesh(mesh):
+        l_xla = jax.jit(lambda p, t: forward(
+            cfg_r, p, t, positions=pos, segment_ids=segs)[0])(params, toks)
+        l_fl = jax.jit(lambda p, t: forward(
+            cfg_rf, p, t, positions=pos, segment_ids=segs)[0])(params, toks)
+    valid = np.asarray(segs) != 0
+    np.testing.assert_allclose(np.asarray(l_xla)[valid],
+                               np.asarray(l_fl)[valid],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_flash_save_attn_out_skips_fwd_ring_recompute():
+    """The ring's (out, lse) are tagged OUTSIDE the custom_vjp and the
+    shard_map (names nested in either are invisible to checkpoint
+    policies), so save_attn_out must drop the forward-ring re-run from
+    the backward pass. Pallas call SITES in the grad jaxpr:
+    nothing_saveable = 8 (fwd local+scan, recomputed fwd local+scan,
+    bwd local dq+dkv, bwd scan dq+dkv); save_attn_out = 6."""
+    from tests.test_flash_attention import _count_pallas_calls
+
+    base = dataclasses.replace(cfg_with("ring"), ring_flash_inner=True,
+                               flash_block_q=16, flash_block_k=16)
+    tokens = jnp.zeros((2, 64), jnp.int32)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, sequence=4, tensor=1))
+    counts = {}
+    with jax.set_mesh(mesh):
+        for policy in ("nothing_saveable", "save_attn_out"):
+            cfg = dataclasses.replace(base, remat_policy=policy)
+            params = init_params(cfg, jax.random.key(0))
+
+            def loss(p, cfg=cfg):
+                logits, _ = forward(cfg, p, tokens, remat=True)
+                return jnp.mean(logits)
+
+            jaxpr = jax.make_jaxpr(jax.grad(loss))(params)
+            counts[policy] = _count_pallas_calls(jaxpr.jaxpr)
+    assert counts["nothing_saveable"] == 8, counts
+    assert counts["save_attn_out"] == 6, counts
