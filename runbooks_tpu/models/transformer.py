@@ -726,12 +726,53 @@ def loss_and_grads_1f1b(
         nll = -jnp.sum(jnp.where(onehot, logp, 0.0), axis=-1)
         return jnp.sum(nll * w) * inv_total
 
+    # Vocab-parallel head for untied models: the [h, vocab] head shards
+    # over the stage axis (head FLOPs drop S x back to the oracle's —
+    # see pipeline_1f1b_grads docstring) and the loss becomes a global
+    # log-softmax over the stage-sharded vocab: a stop-gradient'ed pmax
+    # for stability, a psum'd sum-exp, and a per-stage PARTIAL loss
+    # (lse/S - local target logit) whose stage-psum is the true loss —
+    # autodiff through the psums yields exactly w*(softmax - onehot) on
+    # each slice. Tied embeddings keep the replicated path (the embedding
+    # must stay whole for the embedding fwd/bwd outside the pipeline).
+    Vs = cfg.vocab_size // n_stages
+    use_sharded_head = (not cfg.tie_embeddings
+                        and cfg.vocab_size % n_stages == 0)
+
+    def head_loss_fn_sharded(nl, y, lc):
+        tgt, w = lc
+        h = _norm(cfg, nl["final_norm"], y)
+        z = jnp.einsum("bsh,hv->bsv", h.astype(ad), nl["head"].astype(ad),
+                       preferred_element_type=jnp.float32)  # [b, s, V/S]
+        # stop_gradient BEFORE pmax: pmax has no differentiation rule,
+        # and the max is only a stabilization shift anyway.
+        m = jax.lax.pmax(
+            jax.lax.stop_gradient(jnp.max(z, axis=-1)), "stage")
+        sumexp = jax.lax.psum(
+            jnp.sum(jnp.exp(z - m[..., None]), axis=-1), "stage")
+        lse = m + jnp.log(sumexp)
+        lo = jax.lax.axis_index("stage").astype(tgt.dtype) * Vs
+        onehot = (jnp.arange(Vs, dtype=tgt.dtype)[None, None]
+                  == (tgt[..., None] - lo))
+        z_t_local = jnp.sum(jnp.where(onehot, z, 0.0), axis=-1)
+        partial_nll = lse / n_stages - z_t_local
+        return jnp.sum(partial_nll * w) * inv_total
+
+    head_specs = None
+    active_head_loss = head_loss_fn
+    if use_sharded_head:
+        from jax.sharding import PartitionSpec as P
+
+        head_specs = jax.tree.map(lambda _: P(), nl_params)
+        head_specs["head"] = P(None, "stage")
+        active_head_loss = head_loss_fn_sharded
+
     aux_scale = (cfg.moe_aux_coef / M) if cfg.moe_num_experts else 0.0
     loss_sum, layer_grads, head_grads, dx, aux_mean = pipeline_1f1b_grads(
-        blk_fn, head_loss_fn, params["layers"], nl_params, x,
+        blk_fn, active_head_loss, params["layers"], nl_params, x,
         (positions, segment_ids, mask, bias), (targets, weights),
         mesh=mesh, n_stages=n_stages, n_microbatches=M,
-        aux_scale=aux_scale)
+        aux_scale=aux_scale, head_specs=head_specs)
 
     (embed_grads,) = embed_vjp(dx)
     nl_grads = jax.tree.map(lambda a, g: a + g, embed_grads, head_grads)

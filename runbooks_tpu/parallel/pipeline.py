@@ -45,6 +45,18 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
+def _psum(x, axis):
+    """psum that survives the CPU backend: XLA CPU's AllReducePromotion
+    pass crashes on bf16 all-reduces ("Invalid binary instruction opcode
+    copy" CHECK, observed on this jaxlib) — upcast around the collective
+    there. On TPU the native bf16 all-reduce is kept (half the ICI
+    bytes)."""
+    if x.dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
+        return jax.lax.psum(x.astype(jnp.float32),
+                            axis).astype(jnp.bfloat16)
+    return jax.lax.psum(x, axis)
+
+
 def _mb_index(tree, idx):
     """Select microbatch idx (traced ok) from arrays shaped [M, ...]."""
     return jax.tree.map(
@@ -128,7 +140,7 @@ def pipeline_apply(
         is_last = (stage == S - 1).astype(out_buf.dtype)
         # aux: every stage saw every microbatch once -> psum over stages
         # sums over layers; divide by M for the per-batch mean.
-        return (jax.lax.psum(out_buf * is_last, axis),
+        return (_psum(out_buf * is_last, axis),
                 jax.lax.psum(aux_total, axis) / M)
 
     # Manual only over the stage axis: data/fsdp/sequence/tensor sharding
@@ -160,6 +172,9 @@ def pipeline_1f1b_grads(
     n_microbatches: Optional[int] = None,
     axis: str = "stage",
     aux_scale: float = 0.0,            # cotangent for block aux (MoE coef/M)
+    head_specs: Any = None,            # per-leaf PartitionSpec for
+                                       # head_params; any non-replicated
+                                       # leaf selects the SHARDED head path
 ):
     """1F1B training pipeline: returns (loss_sum, layer_grads, head_grads,
     dx [b,s,h], aux_mean).
@@ -181,6 +196,28 @@ def pipeline_1f1b_grads(
     scalar loss* (caller pre-scales by 1/total_weight); its grads w.r.t.
     head_params accumulate across microbatches and are psum'd, and its
     grad w.r.t. y seeds the backward.
+
+    Head scheduling: the last stage's forward microbatch index t - (S-1)
+    is STATIC per tick, so the head runs only in the tick window
+    [S-1, S-2+M] — M head invocations per stage instead of one per tick
+    (a Python-level if: uniform across stages, no GSPMD non-uniformity).
+    Within the window two modes:
+
+    - replicated head_params (default): every stage runs the head on its
+      own y and masks to the last stage (the r3/r4 shape) — S x the
+      oracle's head FLOPs, acceptable for small vocabularies and required
+      for tied embeddings.
+    - sharded head_specs (e.g. the [h, vocab] head split over the stage
+      axis): the last stage's y broadcasts (one h-sized psum), every
+      stage computes its vocab slice of the head fwd+bwd, and the dy
+      partials psum back (second h-sized psum). head_loss_fn must be
+      written vocab-parallel (global log-softmax via psum/pmax over the
+      stage axis, returning a per-stage partial loss whose stage-psum is
+      the true loss — models/transformer.loss_and_grads_1f1b provides
+      this). Total head FLOPs = 1 x the oracle at the cost of two
+      h-sized collectives per tick: the S x masked-head overhead
+      (~(S-1) x 2*s*h*V/M FLOPs per tick, dominant at llama-3-size
+      vocabularies) becomes ICI traffic that overlaps with compute.
 
     The microbatch feed is block-sharded over stages (in_spec P(axis)) and
     rotated toward stage 0 every M/S ticks — stage 0 consumes each block
@@ -204,6 +241,16 @@ def pipeline_1f1b_grads(
     Q = M // S                 # microbatches per feed block
     R = min(M, 2 * S - 1)      # residual ring slots
     T = M + 2 * (S - 1)        # double-pumped ticks
+
+    if head_specs is None:
+        head_specs = jax.tree.map(lambda _: P(), head_params)
+    # Leaves with a replicated spec hold identical values on every stage
+    # and their grads psum at the end; sharded leaves (vocab-split head)
+    # keep per-stage grad slices that the outer shard_map reassembles.
+    head_psum_mask = jax.tree.map(
+        lambda spec: all(a is None for a in spec), head_specs,
+        is_leaf=lambda s: isinstance(s, P))
+    sharded_head = not all(jax.tree.leaves(head_psum_mask))
 
     def to_mb(a):
         return a.reshape((M, b // M) + a.shape[1:])
@@ -273,21 +320,37 @@ def pipeline_1f1b_grads(
             x_saved = jax.lax.dynamic_index_in_dim(
                 ring, mb_b_c % R, axis=0, keepdims=False)
 
-            # Head + loss + dy for this tick's forward microbatch. Runs
-            # UNCONDITIONALLY on every stage and is masked to the last
-            # stage: the head einsum is tensor-sharded, and GSPMD
-            # collectives inside a stage-non-uniform lax.cond crash the
-            # partitioner (spmd_partitioner_util CHECK, observed) — SPMD
-            # programs must issue collectives uniformly. Costs each stage
-            # one microbatch head fwd/bwd per tick; the GPipe oracle pays
-            # the same head FLOPs once on the full batch.
-            head_m = jnp.logical_and(is_last, f_valid)
-            loss_t, (ghead_t, dy_t) = head_vg(
-                head_params, y_f, _mb_index(loss_consts_mb, mb_f_c))
-            loss_sum = loss_sum + jnp.where(head_m, loss_t, 0.0)
-            gacc_head = jax.tree.map(
-                lambda a, g: a + jnp.where(head_m, g, 0),
-                gacc_head, ghead_t)
+            # Head + loss + dy. The last stage's forward microbatch index
+            # t - (S-1) is static, so the head runs only in the tick
+            # window where it is in range — a Python if, uniform across
+            # stages (GSPMD collectives inside a stage-non-uniform
+            # lax.cond crash the partitioner: spmd_partitioner_util CHECK,
+            # observed). Replicated mode masks to the last stage; sharded
+            # mode broadcasts the last stage's y and computes vocab
+            # slices everywhere (see docstring).
+            if S - 1 <= t <= S - 2 + M:
+                head_mb = t - (S - 1)
+                lc = _mb_index(loss_consts_mb, head_mb)
+                if sharded_head:
+                    y_head = _psum(jnp.where(is_last, y_f, 0), axis)
+                    loss_t, (ghead_t, dy_loc) = head_vg(head_params,
+                                                        y_head, lc)
+                    # Partial loss / local slice grads: real on every
+                    # stage, no mask.
+                    loss_sum = loss_sum + loss_t
+                    gacc_head = jax.tree.map(lambda a, g: a + g,
+                                             gacc_head, ghead_t)
+                    dy_t = _psum(dy_loc, axis)
+                else:
+                    # Non-last stages run on their own (wrong-microbatch)
+                    # y_f and are masked out — uniformity over FLOPs.
+                    loss_t, (ghead_t, dy_t) = head_vg(head_params, y_f, lc)
+                    loss_sum = loss_sum + jnp.where(is_last, loss_t, 0.0)
+                    gacc_head = jax.tree.map(
+                        lambda a, g: a + jnp.where(is_last, g, 0),
+                        gacc_head, ghead_t)
+            else:
+                dy_t = jnp.zeros_like(mb_shape)
 
             # ---- backward sub-step: B(mb_b, stage) at tick
             # mb_b + 2(S-1) - stage. Rematerialize the block from the saved
@@ -319,14 +382,18 @@ def pipeline_1f1b_grads(
                         feed, axis, [(i, (i - 1) % S) for i in range(S)])
 
         is_first = (stage == 0).astype(dx_buf.dtype)
-        dx_full = jax.lax.psum(dx_buf * is_first, axis)
+        dx_full = _psum(dx_buf * is_first, axis)
         loss_sum = jax.lax.psum(loss_sum, axis)
-        gacc_head = jax.tree.map(lambda g: jax.lax.psum(g, axis), gacc_head)
+        # Replicated head leaves: every stage contributed a (masked or
+        # partial) grad -> psum. Sharded leaves: each stage already holds
+        # exactly its slice's grad; the outer shard_map reassembles.
+        gacc_head = jax.tree.map(
+            lambda g, do_psum: _psum(g, axis) if do_psum else g,
+            gacc_head, head_psum_mask)
         aux_mean = jax.lax.psum(aux_sum, axis) / M
         return loss_sum, gacc_layers, gacc_head, dx_full, aux_mean
 
     layer_specs = jax.tree.map(lambda _: P(axis), layers)
-    head_specs = jax.tree.map(lambda _: P(), head_params)
     const_specs = jax.tree.map(lambda _: P(), consts_mb)
     lconst_specs = jax.tree.map(lambda _: P(), loss_consts_mb)
     loss_sum, layer_grads, head_grads, dx, aux_mean = jax.shard_map(

@@ -286,3 +286,54 @@ def test_pipeline_composes_with_ring_attention():
 
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("over", [
+    dict(tie_embeddings=True),     # tied: head must stay replicated
+    dict(vocab_size=65),           # odd: 65 % 2 != 0 -> replicated fallback
+], ids=["tied", "indivisible-vocab"])
+def test_1f1b_replicated_head_path_matches_autodiff(over):
+    """The vocab-sharded head only applies to untied, stage-divisible
+    vocabularies; these configs must take the replicated-head path and
+    still match plain autodiff exactly."""
+    from runbooks_tpu.models.transformer import loss_and_grads_1f1b
+
+    cfg = pp_cfg(pipeline_microbatches=4, **over)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg)
+    targets = batch_tokens(cfg, seed=1)
+
+    plain = make_mesh(MeshConfig(fsdp=8))
+    with jax.set_mesh(plain):
+        want_loss, want_grads, _ = jax.jit(
+            lambda p: loss_weight_grads_ref(cfg, p, tokens, targets, None)
+        )(params)
+
+    pp_mesh = make_mesh(MeshConfig(stage=2, fsdp=4))
+    with jax.set_mesh(pp_mesh):
+        got_loss, got_grads, _ = jax.jit(
+            lambda p: loss_and_grads_1f1b(cfg, p, tokens, targets, None)
+        )(params)
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-5)
+    for w, g in zip(jax.tree.leaves(want_grads), jax.tree.leaves(got_grads)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_bf16_activations_compile_on_cpu():
+    """bf16 activations cross the pipeline's psums (y broadcast, dy, dx):
+    XLA CPU's AllReducePromotion crashes on bf16 all-reduces, so _psum
+    upcasts around the collective there (TPU keeps native bf16). This
+    pins the CPU-gate path — without the workaround this test aborts the
+    process, not just fails."""
+    from runbooks_tpu.models.transformer import loss_and_grads_1f1b
+
+    cfg = pp_cfg(pipeline_microbatches=2, dtype="bfloat16")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = batch_tokens(cfg)
+    pp_mesh = make_mesh(MeshConfig(stage=2, fsdp=4))
+    with jax.set_mesh(pp_mesh):
+        loss, grads, _ = jax.jit(
+            lambda p: loss_and_grads_1f1b(cfg, p, tokens, tokens))(params)
+    assert np.isfinite(float(loss))
