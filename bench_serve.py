@@ -50,6 +50,11 @@ def inner() -> None:
     # timeout). 512 covers prompt+max_tokens with a bucket to spare.
     max_seq = int(os.environ.get("RBT_BENCH_MAXSEQ", 512 if on_tpu else 0))
 
+    # Shared-prefix load: RBT_BENCH_PREFIX=P makes every request share a
+    # P-token registered prefix (chat-system-prompt shape); the engine
+    # prefills only the (prompt_len - P)-token suffix. 0 = off.
+    prefix_len = int(os.environ.get("RBT_BENCH_PREFIX", 0))
+
     cfg = get_config(model, param_dtype="bfloat16" if on_tpu else "float32")
     params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
     engine = InferenceEngine(cfg, params, max_slots=slots,
@@ -71,6 +76,17 @@ def inner() -> None:
             super().append(tok)
 
     rng = np.random.default_rng(0)
+    shared = []
+    if prefix_len:
+        # Leave >= 16 suffix tokens so prompts stay inside the context
+        # window, and only keep the prefix the engine actually cached
+        # (rounds down to 16; < 16 caches nothing).
+        prefix_len = min(prefix_len, prompt_len - 16)
+        if prefix_len >= 16:
+            shared = rng.integers(1, cfg.vocab_size, prefix_len).tolist()
+            cached = engine.register_prefix(shared)  # compiles pre-traffic
+            if not cached:
+                shared = []
     ttfts = []
     lock = threading.Lock()
 
@@ -81,7 +97,8 @@ def inner() -> None:
     t_all = time.perf_counter()
     futs = []
     for _ in range(n_requests):
-        toks = rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+        suffix_n = max(prompt_len - len(shared), 1)
+        toks = shared + rng.integers(1, cfg.vocab_size, suffix_n).tolist()
         req = Request(prompt_tokens=toks, max_tokens=max_tokens,
                       temperature=0.0)
         req.output_tokens = TimedList(time.perf_counter(), sink)
@@ -106,6 +123,7 @@ def inner() -> None:
                              1),
         "decode_tokens_per_sec": round(total_tokens / wall, 1),
         "decode_chunk": engine.decode_chunk,
+        "prefix_tokens_reused": engine.prefix_tokens_reused,
         "platform": jax.default_backend(),
         "device": str(device),
     }))

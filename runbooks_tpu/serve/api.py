@@ -94,6 +94,8 @@ class EngineWorker:
         self.engine = engine
         self._pending: list[Tuple[Request, Future]] = []
         self._inflight: list[Tuple[Request, Future]] = []
+        self._prefix_jobs: list[Tuple[list, Future]] = []
+        self._prefix_warm_queue: list[tuple] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -110,19 +112,56 @@ class EngineWorker:
         self._wake.set()
         return fut
 
+    def register_prefix(self, tokens: list) -> Future:
+        """Register a shared prompt prefix on the worker thread (the
+        engine is single-threaded by design; touching it from an HTTP
+        handler would race the step loop). Resolves to the cached
+        length."""
+        fut: Future = Future()
+        with self._lock:
+            self._prefix_jobs.append((tokens, fut))
+        self._wake.set()
+        return fut
+
     def _run(self) -> None:
         while not self._stop:
             try:
                 with self._lock:
+                    prefix_jobs, self._prefix_jobs = self._prefix_jobs, []
                     for req, fut in self._pending:
                         self.engine.submit(req)
                         self._inflight.append((req, fut))
                     self._pending.clear()
+                for tokens, fut in prefix_jobs:
+                    try:
+                        # Register WITHOUT the inline warmup sweep (each
+                        # shape is an XLA compile — ~27 s cold on the v5e
+                        # relay; the whole sweep inline would freeze every
+                        # in-flight stream). Shapes queue and warm one per
+                        # loop iteration, interleaved with decode steps.
+                        plen = self.engine.register_prefix(tokens,
+                                                           warmup=False)
+                        if plen:
+                            key = tuple(int(t) for t in tokens[:plen])
+                            self._prefix_warm_queue.extend(
+                                (key, b, r) for b, r in
+                                self.engine.prefix_warmup_shapes(plen))
+                        fut.set_result(plen)
+                    except Exception as exc:  # noqa: BLE001
+                        if not fut.done():
+                            fut.set_exception(exc)
                 if not self.engine.has_work():
+                    if self._prefix_warm_queue:
+                        self.engine.warm_prefix_shape(
+                            *self._prefix_warm_queue.pop(0))
+                        continue
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
                 self.engine.step()
+                if self._prefix_warm_queue:
+                    self.engine.warm_prefix_shape(
+                        *self._prefix_warm_queue.pop(0))
                 done = [(r, f) for r, f in self._inflight if r.finished]
                 if done:
                     self._inflight = [(r, f) for r, f in self._inflight
@@ -190,6 +229,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             f"serve_decode_steps_total {eng.steps}",
             f"serve_active_slots {int(eng.active.sum())}",
             f"serve_queue_depth {len(eng.queue)}",
+            f"serve_prefix_tokens_reused_total {eng.prefix_tokens_reused}",
         ]
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
@@ -463,11 +503,43 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         } for c in payload["choices"]]
         return web.json_response(payload)
 
+    async def register_prefix(request: web.Request) -> web.Response:
+        """Register a shared prompt prefix (e.g. a deployment's chat
+        system prompt) so subsequent requests that start with it prefill
+        only their suffix. Body: {"prompt": "..."} (tokenized like
+        /v1/completions) or {"tokens": [...]}. Returns the cached prefix
+        length (0 = too short to cache)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400)
+        tokens = body.get("tokens")
+        if tokens is None:
+            prompt = body.get("prompt")
+            if not isinstance(prompt, str):
+                return web.json_response(
+                    {"error": {"message": "provide prompt (string) or "
+                                          "tokens (list of ints)"}},
+                    status=400)
+            tok = request.app["tokenizer"]
+            tokens = list(tok.encode(prompt, add_bos=True, add_eos=False)
+                          if hasattr(tok, "bos_id") else tok.encode(prompt))
+        if not (isinstance(tokens, list)
+                and all(isinstance(t, int) for t in tokens)):
+            return web.json_response(
+                {"error": {"message": "tokens must be a list of ints"}},
+                status=400)
+        fut = worker.register_prefix(tokens)
+        plen = await asyncio.wrap_future(fut)
+        return web.json_response({"cached_prefix_len": plen})
+
     app.router.add_get("/", root)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/prefix", register_prefix)
 
     async def on_cleanup(app):
         worker.stop()

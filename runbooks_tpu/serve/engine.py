@@ -169,11 +169,26 @@ class InferenceEngine:
         self.rng = jax.random.key(seed)
         self.prefill_buckets = _buckets(self.max_seq_len)
         self.steps = 0
+        # Shared-prefix KV cache: registered prompt prefixes (chat system
+        # prompts) keep their per-layer K/V on device; admissions whose
+        # prompt starts with a registered prefix prefill only the SUFFIX.
+        # LRU-bounded; keys are token tuples, values (k, v) arrays of
+        # static shape [L, plen, kv_h, d]. Decode is bandwidth-bound and
+        # prefill compute is quadratic-ish in bucket size, so for a
+        # B-token shared system prompt this removes a B-bucket prefill
+        # per request — the next TTFT lever after bucketed views
+        # (BENCH_NOTES r3 queue).
+        self.prefix_cache_size = 4
+        # Ordered dict doubles as the LRU: last key = most recently used
+        # (registration AND admission hits refresh), first key evicts.
+        self._prefix_cache: "dict[tuple, tuple]" = {}
+        self.prefix_tokens_reused = 0   # observability/tests
 
         cache_len = self.max_seq_len + 1
 
         def prefill_fn(params, cache_k, cache_v, tokens, positions, slots,
-                       last_pos, rng, temps, top_ks, top_ps):
+                       last_pos, rng, temps, top_ks, top_ps,
+                       pk=None, pv=None):
             # Prefill `rows` requests into fresh zero rows at once, then
             # splice each row into the pool cache (donated => in-place, no
             # full-cache copy). Stale data from a slot's previous occupant
@@ -192,10 +207,20 @@ class InferenceEngine:
             rows = tokens.shape[0]
             row_shape = (cfg.num_layers, rows, cache_len, cfg.num_kv_heads,
                          cfg.head_dim)
-            cache1 = KVCache(
-                k=jnp.zeros(row_shape, cfg.activation_dtype),
-                v=jnp.zeros(row_shape, cfg.activation_dtype),
-                index=jnp.zeros((), jnp.int32))
+            k1 = jnp.zeros(row_shape, cfg.activation_dtype)
+            v1 = jnp.zeros(row_shape, cfg.activation_dtype)
+            if pk is not None:
+                # Shared-prefix reuse: the registered prefix's K/V
+                # [L, plen, kv_h, d] lands in slots [0, plen) of every
+                # scratch row (exact length — no pad keys a suffix query
+                # could wrongly attend), and `tokens` holds only the
+                # SUFFIX, positions starting at plen.
+                plen = pk.shape[1]
+                k1 = k1.at[:, :, :plen].set(
+                    pk[:, None].astype(cfg.activation_dtype))
+                v1 = v1.at[:, :, :plen].set(
+                    pv[:, None].astype(cfg.activation_dtype))
+            cache1 = KVCache(k=k1, v=v1, index=jnp.zeros((), jnp.int32))
             logits, cache1 = forward(cfg, params, tokens,
                                      positions=positions, cache=cache1)
             new_k, new_v = cache_k, cache_v
@@ -211,6 +236,25 @@ class InferenceEngine:
             return first, new_k, new_v, rng
 
         self._prefill = jax.jit(prefill_fn, donate_argnums=(1, 2))
+        # Same body with the prefix splice live (jit specializes per
+        # (plen, suffix-bucket, rows) shape; registrations are rare and
+        # suffix buckets are the same bounded set as prefill buckets).
+        self._prefill_prefix = jax.jit(
+            lambda params, ck, cv, pk, pv, *rest: prefill_fn(
+                params, ck, cv, *rest, pk=pk, pv=pv),
+            donate_argnums=(1, 2))
+
+        def prefix_build_fn(params, tokens, positions, plen):
+            row_shape = (cfg.num_layers, 1, cache_len, cfg.num_kv_heads,
+                         cfg.head_dim)
+            c1 = KVCache(k=jnp.zeros(row_shape, cfg.activation_dtype),
+                         v=jnp.zeros(row_shape, cfg.activation_dtype),
+                         index=jnp.zeros((), jnp.int32))
+            _, c1 = forward(cfg, params, tokens, positions=positions,
+                            cache=c1)
+            return c1.k[:, 0, :plen], c1.v[:, 0, :plen]
+
+        self._prefix_build = jax.jit(prefix_build_fn, static_argnums=(3,))
 
         chunk = self.decode_chunk
         max_len = self.max_seq_len
@@ -316,6 +360,97 @@ class InferenceEngine:
     # Request lifecycle
     # ------------------------------------------------------------------
 
+    # -- shared-prefix cache -------------------------------------------
+
+    def register_prefix(self, tokens: List[int], warmup: bool = True) -> int:
+        """Compute and cache the KV for a shared prompt prefix (e.g. a chat
+        system prompt). Returns the cached prefix length (0 = too short).
+
+        The cached length rounds DOWN to a multiple of 16 (bounds the set
+        of compiled splice shapes) and leaves at least one prompt token to
+        prefill (sampling needs a real suffix logit). Subsequent requests
+        whose prompt starts with the registered tokens prefill only their
+        suffix — for a B-token system prompt that removes a B-bucket
+        prefill from every request's TTFT.
+
+        warmup=True (default) compiles the splice-prefill for every
+        (suffix bucket x row count) this prefix can produce, against
+        throwaway cache buffers — like warmup(), serve-time compiles are
+        the TTFT killer (measured: the uncompiled prefix path turned a
+        79 ms CPU p50 into 4.7 s). Registration is one-time per prefix
+        shape; do it before traffic."""
+        plen = min(len(tokens), self.max_seq_len - 16) // 16 * 16
+        if plen < 16:
+            return 0
+        key = tuple(int(t) for t in tokens[:plen])
+        if key in self._prefix_cache:
+            self._prefix_cache[key] = self._prefix_cache.pop(key)  # refresh
+            return plen
+        bucket = self._bucket_for(plen)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :plen] = key
+        pos = np.full((1, bucket), self._pad_slot, np.int32)
+        pos[0, :plen] = np.arange(plen)
+        with self._mesh_ctx():
+            pk, pv = self._prefix_build(self.params, jnp.asarray(toks),
+                                        jnp.asarray(pos), plen)
+        self._prefix_cache[key] = (pk, pv)
+        if len(self._prefix_cache) > self.prefix_cache_size:
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+        if warmup:
+            for bucket, rows in self.prefix_warmup_shapes(plen):
+                self.warm_prefix_shape(key, bucket, rows)
+        return plen
+
+    def prefix_warmup_shapes(self, plen: int) -> List[tuple]:
+        """(suffix bucket, rows) shapes the splice-prefill can run at for
+        a plen-token prefix — the compile set warm-up walks."""
+        max_suffix = self._bucket_for(self.max_seq_len - plen)
+        rows_set = (1, self.max_slots) if self.max_slots > 1 else (1,)
+        return [(b, r) for b in self.prefill_buckets if b <= max_suffix
+                for r in rows_set]
+
+    def warm_prefix_shape(self, key: tuple, bucket: int, rows: int) -> None:
+        """Compile ONE prefix splice-prefill shape against THROWAWAY
+        pool-cache buffers (the real pool cache may hold live slots;
+        warmup writes must not touch it). Exposed shape-at-a-time so the
+        serving worker can interleave compiles with decode steps instead
+        of freezing every stream for the whole sweep."""
+        if key not in self._prefix_cache:
+            return  # evicted since queued
+        pk, pv = self._prefix_cache[key]
+        plen = len(key)
+        toks = np.zeros((rows, bucket), np.int32)
+        positions = np.full((rows, bucket), self._pad_slot, np.int32)
+        positions[:, 0] = plen
+        dummy = KVCache.create(self.cfg, self.max_slots,
+                               self.max_seq_len, trash_slot=True)
+        if self._cache_sharding is not None:
+            dummy = KVCache(
+                k=jax.device_put(dummy.k,
+                                 self._cache_sharding(dummy.k.shape)),
+                v=jax.device_put(dummy.v,
+                                 self._cache_sharding(dummy.v.shape)),
+                index=dummy.index)
+        with self._mesh_ctx():
+            self._prefill_prefix(
+                self.params, dummy.k, dummy.v, pk, pv,
+                jnp.asarray(toks), jnp.asarray(positions),
+                jnp.zeros(rows, jnp.int32), jnp.zeros(rows, jnp.int32),
+                jax.random.key(0), jnp.zeros(rows, jnp.float32),
+                jnp.zeros(rows, jnp.int32), jnp.ones(rows, jnp.float32))
+
+    def _find_prefix(self, prompt: List[int]):
+        """Longest registered prefix this prompt starts with, leaving at
+        least one suffix token; None if no match."""
+        best = None
+        for key in self._prefix_cache:
+            if len(key) < len(prompt) and (best is None
+                                           or len(key) > len(best)):
+                if tuple(prompt[:len(key)]) == key:
+                    best = key
+        return best
+
     def validate(self, req: Request) -> None:
         """Raise ValueError for requests that can never be served (callers
         should surface this as a 400, before the request enters the queue)."""
@@ -365,64 +500,87 @@ class InferenceEngine:
             if not self.queue:
                 break
             # Budget in bucket-padded tokens (what the prefill actually
-            # computes). The first admission always goes through so an
-            # over-budget prompt cannot starve.
-            need = self._bucket_for(len(self.queue[0].prompt_tokens))
+            # computes — only the SUFFIX when a registered prefix covers
+            # the front of the prompt). The first admission always goes
+            # through so an over-budget prompt cannot starve.
+            head = self.queue[0]
+            pkey = self._find_prefix(head.prompt_tokens)
+            need = self._bucket_for(
+                len(head.prompt_tokens) - (len(pkey) if pkey else 0))
             if admitted and need > budget:
                 break
             req = self.queue.pop(0)
             budget -= need
-            admitted.append((slot, req))
+            admitted.append((slot, req, pkey))
         if not admitted:
             return
-        # Group this tick's admissions by bucket: one [rows, bucket]
-        # prefill dispatch per bucket instead of one per request.
-        by_bucket: dict = {}
-        for slot, req in admitted:
-            b = self._bucket_for(len(req.prompt_tokens))
-            by_bucket.setdefault(b, []).append((slot, req))
-        for bucket, group in by_bucket.items():
-            self._prefill_group(bucket, group)
+        # Group this tick's admissions by (bucket, prefix): one
+        # [rows, bucket] prefill dispatch per group instead of one per
+        # request.
+        by_group: dict = {}
+        for slot, req, pkey in admitted:
+            b = self._bucket_for(
+                len(req.prompt_tokens) - (len(pkey) if pkey else 0))
+            by_group.setdefault((b, pkey), []).append((slot, req))
+        for (bucket, pkey), group in by_group.items():
+            self._prefill_group(bucket, group, pkey)
 
-    def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
+    def _prefill_group(self, bucket: int, group: List[tuple],
+                       pkey: Optional[tuple] = None) -> None:
         """Prefill same-bucket requests as one batched forward. The row
         count is 1 (single request) or max_slots (any burst) — exactly the
         two shapes warmup() compiles, so a burst can never trigger a
         serve-time compile (measured on the v5e relay: one cold [8,128]
         prefill compile cost ~27 s of TTFT). Padding rows aim at group[0]'s
         slot and are overwritten by the real row 0 (the jitted splice runs
-        rows in descending order)."""
+        rows in descending order).
+
+        With pkey (a registered shared prefix), rows hold only the SUFFIX
+        tokens at positions starting after the prefix; the jitted step
+        splices the cached prefix K/V into every scratch row first."""
         n = len(group)
+        plen = len(pkey) if pkey else 0
         rows = 1 if n == 1 else self.max_slots
         tokens = np.zeros((rows, bucket), np.int32)
-        # Real tokens at positions 0..len-1; padding scatters to the trash
-        # slot of each row's scratch cache.
+        # Real tokens at positions plen..len-1; padding scatters to the
+        # trash slot of each row's scratch cache.
         positions = np.full((rows, bucket), self._pad_slot, np.int32)
         slots = np.full(rows, group[0][0], np.int32)
         for i, (slot, req) in enumerate(group):
-            m = len(req.prompt_tokens)
-            tokens[i, :m] = req.prompt_tokens
-            positions[i, :m] = np.arange(m)
+            m = len(req.prompt_tokens) - plen
+            tokens[i, :m] = req.prompt_tokens[plen:]
+            positions[i, :m] = np.arange(plen, plen + m)
             slots[i] = slot
 
         # First generated token of each row comes from its last *real*
-        # prompt position; sampling happens inside the jitted prefill (one
-        # dispatch, no eager sampling chain — see prefill_fn).
+        # prompt position (index into the suffix row); sampling happens
+        # inside the jitted prefill (one dispatch, no eager sampling
+        # chain — see prefill_fn).
         last_pos = np.zeros(rows, np.int32)
         temps = np.zeros(rows, np.float32)
         top_ks = np.zeros(rows, np.int32)
         top_ps = np.ones(rows, np.float32)
         for i, (_, req) in enumerate(group):
-            last_pos[i] = len(req.prompt_tokens) - 1
+            last_pos[i] = len(req.prompt_tokens) - plen - 1
             temps[i] = req.temperature
             top_ks[i] = req.top_k
             top_ps[i] = req.top_p
+        args = (jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(slots), jnp.asarray(last_pos), self.rng,
+                jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps))
         with self._mesh_ctx():
-            first, new_k, new_v, self.rng = self._prefill(
-                self.params, self.cache.k, self.cache.v, jnp.asarray(tokens),
-                jnp.asarray(positions), jnp.asarray(slots),
-                jnp.asarray(last_pos), self.rng, jnp.asarray(temps),
-                jnp.asarray(top_ks), jnp.asarray(top_ps))
+            if pkey:
+                # Admission hit refreshes the LRU position: the prefix
+                # serving live traffic must not be the one evicted.
+                pk, pv = self._prefix_cache[pkey]
+                self._prefix_cache[pkey] = self._prefix_cache.pop(pkey)
+                first, new_k, new_v, self.rng = self._prefill_prefix(
+                    self.params, self.cache.k, self.cache.v, pk, pv, *args)
+                self.prefix_tokens_reused += plen * n
+            else:
+                first, new_k, new_v, self.rng = self._prefill(
+                    self.params, self.cache.k, self.cache.v, *args)
         self.cache = KVCache(k=new_k, v=new_v, index=self.cache.index)
         first = np.asarray(first)
         for i, (slot, req) in enumerate(group):

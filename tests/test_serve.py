@@ -429,3 +429,119 @@ def test_engine_prefill_budget_spreads_admission():
                            temperature=0.0))
     eng.step()
     assert int(eng.active.sum()) == 2 and len(eng.queue) == 2
+
+
+def test_engine_shared_prefix_reuse_matches_full_prefill():
+    """Requests whose prompt starts with a registered prefix must produce
+    EXACTLY the tokens a full prefill would (the cached prefix K/V plus a
+    suffix-only scatter prefill is numerically the same computation), and
+    the engine must actually reuse the prefix (prefix_tokens_reused)."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prefix = [int(t) for t in rng.integers(1, cfg.vocab_size, 16)]
+    suffixes = [[7, 9], [11], [3, 5, 8, 13]]
+
+    ref = InferenceEngine(cfg, params, max_slots=4)
+    reqs_ref = [Request(prompt_tokens=prefix + s, max_tokens=8)
+                for s in suffixes]
+    ref.generate(reqs_ref)
+
+    eng = InferenceEngine(cfg, params, max_slots=4)
+    assert eng.register_prefix(prefix) == 16
+    reqs = [Request(prompt_tokens=prefix + s, max_tokens=8)
+            for s in suffixes]
+    eng.generate(reqs)
+
+    assert eng.prefix_tokens_reused == 16 * len(suffixes)
+    for got, want in zip(reqs, reqs_ref):
+        assert got.output_tokens == want.output_tokens, (
+            got.output_tokens, want.output_tokens)
+
+
+def test_engine_prefix_register_rounds_and_evicts():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    eng = InferenceEngine(cfg, params, max_slots=2)
+    # Too short to cache.
+    assert eng.register_prefix([1, 2, 3]) == 0
+    # 19 tokens round down to 16.
+    toks = list(range(1, 20))
+    assert eng.register_prefix(toks) == 16
+    # Re-registration is a cache hit (no growth).
+    assert eng.register_prefix(toks) == 16
+    assert len(eng._prefix_cache) == 1
+    # LRU bound holds.
+    for i in range(eng.prefix_cache_size + 1):
+        eng.register_prefix([100 + i] * 16)
+    assert len(eng._prefix_cache) == eng.prefix_cache_size
+
+
+def test_engine_prefix_mixed_with_plain_requests():
+    """A tick admitting both prefix-hit and plain requests splits into
+    separate prefill groups and all outputs match the no-prefix engine."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    prefix = list(range(2, 18))
+    prompts = [prefix + [40, 41], [9, 8, 7], prefix + [50]]
+
+    ref = InferenceEngine(cfg, params, max_slots=4)
+    reqs_ref = [Request(prompt_tokens=p, max_tokens=6) for p in prompts]
+    ref.generate(reqs_ref)
+
+    eng = InferenceEngine(cfg, params, max_slots=4)
+    eng.register_prefix(prefix)
+    reqs = [Request(prompt_tokens=p, max_tokens=6) for p in prompts]
+    eng.generate(reqs)
+    assert eng.prefix_tokens_reused == 32
+    for got, want in zip(reqs, reqs_ref):
+        assert got.output_tokens == want.output_tokens
+
+
+def test_http_prefix_registration_endpoint():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from runbooks_tpu.serve.api import create_server
+
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    app = create_server(cfg, params, max_slots=2)
+
+    async def drive():
+        async with TestClient(TestServer(app)) as client:
+            toks = list(range(2, 22))
+            r = await client.post("/v1/prefix", json={"tokens": toks})
+            assert r.status == 200
+            assert (await r.json())["cached_prefix_len"] == 16
+
+            # A completion whose prompt starts with the prefix reuses it.
+            eng = app["worker"].engine
+            before = eng.prefix_tokens_reused
+            req = Request(prompt_tokens=toks[:16] + [30, 31], max_tokens=3)
+            fut = app["worker"].submit(req)
+            await asyncio.wrap_future(fut)
+            assert eng.prefix_tokens_reused == before + 16
+
+            r = await client.post("/v1/prefix", json={"tokens": "nope"})
+            assert r.status == 400
+            r = await client.get("/metrics")
+            assert "serve_prefix_tokens_reused_total 16" in await r.text()
+
+    asyncio.run(drive())
+
+
+def test_engine_prefix_in_use_survives_eviction_pressure():
+    """Admission hits refresh the LRU: the prefix serving live traffic
+    must outlive later registrations."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    eng = InferenceEngine(cfg, params, max_slots=2)
+    hot = list(range(2, 18))
+    eng.register_prefix(hot)
+    # Traffic keeps hitting the hot prefix while cold prefixes register
+    # past the cache bound; each admission hit refreshes its LRU slot.
+    for i in range(eng.prefix_cache_size):
+        eng.generate([Request(prompt_tokens=hot + [30 + i], max_tokens=2)])
+        eng.register_prefix([100 + i] * 16)
+    assert eng.prefix_tokens_reused == 16 * eng.prefix_cache_size
+    assert tuple(hot) in eng._prefix_cache, "hot prefix was evicted"
