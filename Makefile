@@ -6,13 +6,23 @@
 TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
+# Default gate = the fast path: everything except @pytest.mark.slow
+# (redundant-coverage heavyweights — full-parity sweeps, checkpoint
+# roundtrips, multi-process rendezvous). The slow set runs in test-all
+# (nightly CI + before releases). Rationale: the full suite costs >20 min
+# serially on a small box, and a slow gate is where skipped-gate
+# temptation breeds (round 3 shipped red for exactly this reason).
 .PHONY: test
 test:
+	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"
+
+.PHONY: test-all
+test-all:
 	$(TEST_ENV) python -m pytest tests/ -x -q
 
+# Back-compat alias.
 .PHONY: test-fast
-test-fast:
-	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"
+test-fast: test
 
 .PHONY: bench
 bench:
@@ -36,6 +46,14 @@ nbwatch:
 .PHONY: test-system
 test-system:
 	$(TEST_ENV) python test/system.py
+
+# Real-kind smoke (reference analog: test/system.sh against an actual
+# cluster): builds + loads images, installs the operator, applies the
+# opt-125m example, curls a served completion. Skips where docker/kind
+# are unavailable; see the kind-smoke CI job.
+.PHONY: test-system-kind
+test-system-kind:
+	bash test/system_kind.sh
 
 # --- Dev loop (reference analog: skaffold.{gcp,kind}.yaml + the Makefile
 # dev-run hybrid mode: controller runs LOCALLY against the cluster in the

@@ -31,6 +31,14 @@ from runbooks_tpu.train.data import load_tokenizer
 from runbooks_tpu.utils import contract
 
 
+def _encode(tok, text: str) -> list:
+    """One tokenize path for completions AND prefix registration — they
+    must agree exactly or registered prefixes never match prompts."""
+    ids = tok.encode(text, add_bos=True, add_eos=False) \
+        if hasattr(tok, "bos_id") else tok.encode(text)
+    return list(ids)
+
+
 def _eos_id(tok) -> Optional[int]:
     """Tokenizer EOS id across both tokenizer flavors (ByteTokenizer's
     eos_id, HF's eos_token_id). Explicit None checks: an EOS id of 0 is
@@ -96,6 +104,7 @@ class EngineWorker:
         self._inflight: list[Tuple[Request, Future]] = []
         self._prefix_jobs: list[Tuple[list, Future]] = []
         self._prefix_warm_queue: list[tuple] = []
+        self._prefix_warm_buffers = None  # threaded through warm calls
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -139,9 +148,13 @@ class EngineWorker:
                         # relay; the whole sweep inline would freeze every
                         # in-flight stream). Shapes queue and warm one per
                         # loop iteration, interleaved with decode steps.
+                        fresh = not self.engine.has_prefix(tokens)
                         plen = self.engine.register_prefix(tokens,
                                                            warmup=False)
-                        if plen:
+                        if plen and fresh:
+                            # Re-registrations (LRU refresh) are already
+                            # compiled — re-queueing the sweep would only
+                            # steal device time from live decode ticks.
                             key = tuple(int(t) for t in tokens[:plen])
                             self._prefix_warm_queue.extend(
                                 (key, b, r) for b, r in
@@ -152,16 +165,14 @@ class EngineWorker:
                             fut.set_exception(exc)
                 if not self.engine.has_work():
                     if self._prefix_warm_queue:
-                        self.engine.warm_prefix_shape(
-                            *self._prefix_warm_queue.pop(0))
+                        self._warm_one()
                         continue
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
                 self.engine.step()
                 if self._prefix_warm_queue:
-                    self.engine.warm_prefix_shape(
-                        *self._prefix_warm_queue.pop(0))
+                    self._warm_one()
                 done = [(r, f) for r, f in self._inflight if r.finished]
                 if done:
                     self._inflight = [(r, f) for r, f in self._inflight
@@ -170,29 +181,59 @@ class EngineWorker:
                         if not fut.done():
                             fut.set_result(req)
             except Exception as exc:  # noqa: BLE001 — engine step blew up
-                # Fail every waiting request with the error (hanging futures
-                # would wedge all HTTP handlers forever) and reset the slot
+                # Fail every waiting request AND queued prefix job with
+                # the error (hanging futures would wedge HTTP handlers
+                # forever), drop pending warm shapes, and reset the slot
                 # state so subsequent requests get a clean engine.
                 with self._lock:
                     doomed = self._inflight + self._pending
+                    doomed_prefix = self._prefix_jobs
                     self._inflight, self._pending = [], []
-                for _req, fut in doomed:
+                    self._prefix_jobs = []
+                self._prefix_warm_queue.clear()
+                self._prefix_warm_buffers = None
+                for _req, fut in doomed + doomed_prefix:
                     if not fut.done():
                         fut.set_exception(exc)
                 # Donated buffers (cache) may have been invalidated by the
                 # failed call — full reset reallocates them.
                 self.engine.reset()
 
+    def _warm_one(self) -> None:
+        """Warm one queued prefix shape. Best-effort: a failed speculative
+        compile must never doom live traffic, so failures log and drop the
+        rest of that sweep instead of reaching the run-loop catch-all."""
+        key, bucket, rows = self._prefix_warm_queue.pop(0)
+        try:
+            self._prefix_warm_buffers = self.engine.warm_prefix_shape(
+                key, bucket, rows, self._prefix_warm_buffers)
+        except Exception as exc:  # noqa: BLE001
+            print(f"serve: prefix warmup shape ({bucket}x{rows}) failed, "
+                  f"dropping remaining sweep: {exc!r}", flush=True)
+            self._prefix_warm_queue.clear()
+            self._prefix_warm_buffers = None
+        if not self._prefix_warm_queue:
+            self._prefix_warm_buffers = None  # free the throwaway pool
+
     def stop(self) -> None:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=5)
+        # Queued prefix jobs the loop never reached must not hang their
+        # awaiting HTTP handlers.
+        with self._lock:
+            doomed = self._prefix_jobs
+            self._prefix_jobs = []
+        for _tokens, fut in doomed:
+            if not fut.done():
+                fut.set_exception(RuntimeError("engine worker stopped"))
 
 
 def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   max_slots: int = 8,
                   max_seq_len: Optional[int] = None,
                   mesh=None, warmup: bool = False,
+                  warm_prefix: bool = False,
                   prefill_budget: Optional[int] = None,
                   decode_chunk: Optional[int] = None) -> web.Application:
     tokenizer = tokenizer or load_tokenizer(None)
@@ -201,7 +242,12 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                              prefill_budget=prefill_budget,
                              decode_chunk=decode_chunk)
     if warmup:
-        engine.warmup()  # pre-compile all buckets before readiness flips
+        # Pre-compile all buckets before readiness flips. warm_prefix
+        # (params.json: warm_prefix) additionally compiles the prefix-KV
+        # builder per bucket so runtime /v1/prefix registrations never
+        # compile on the serving thread (cost: len(buckets) extra startup
+        # compiles).
+        engine.warmup(prefix_build=warm_prefix)
     worker = EngineWorker(engine)
     app = web.Application()
     app["worker"] = worker
@@ -273,10 +319,8 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         eos = _eos_id(tok)
         reqs = []
         for p in prompts:
-            ids = tok.encode(p, add_bos=True, add_eos=False) \
-                if hasattr(tok, "bos_id") else tok.encode(p)
             reqs.append(Request(
-                prompt_tokens=list(ids), max_tokens=max_tokens,
+                prompt_tokens=_encode(tok, p), max_tokens=max_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos))
         return reqs, None
@@ -522,16 +566,22 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                     {"error": {"message": "provide prompt (string) or "
                                           "tokens (list of ints)"}},
                     status=400)
-            tok = request.app["tokenizer"]
-            tokens = list(tok.encode(prompt, add_bos=True, add_eos=False)
-                          if hasattr(tok, "bos_id") else tok.encode(prompt))
+            tokens = _encode(request.app["tokenizer"], prompt)
         if not (isinstance(tokens, list)
                 and all(isinstance(t, int) for t in tokens)):
             return web.json_response(
                 {"error": {"message": "tokens must be a list of ints"}},
                 status=400)
         fut = worker.register_prefix(tokens)
-        plen = await asyncio.wrap_future(fut)
+        try:
+            plen = await asyncio.wait_for(asyncio.wrap_future(fut), 600)
+        except asyncio.TimeoutError:
+            return web.json_response(
+                {"error": {"message": "prefix registration timed out"}},
+                status=504)
+        except RuntimeError as exc:
+            return web.json_response(
+                {"error": {"message": str(exc)}}, status=503)
         return web.json_response({"cached_prefix_len": plen})
 
     app.router.add_get("/", root)
@@ -575,6 +625,7 @@ def main() -> int:
         max_seq_len=params.get("max_seq_len"),
         mesh=mesh,
         warmup=bool(params.get("warmup", True)),
+        warm_prefix=bool(params.get("warm_prefix", False)),
         prefill_budget=(int(params["prefill_budget"])
                         if params.get("prefill_budget") is not None
                         else None))

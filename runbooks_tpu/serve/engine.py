@@ -244,7 +244,13 @@ class InferenceEngine:
                 params, ck, cv, *rest, pk=pk, pv=pv),
             donate_argnums=(1, 2))
 
-        def prefix_build_fn(params, tokens, positions, plen):
+        def prefix_build_fn(params, tokens, positions):
+            # Returns the full bucket-width row; the caller slices to the
+            # actual prefix length eagerly. Keeping plen OUT of the jit
+            # key means one compiled program per bucket — a bounded set
+            # warmup(prefix_build=True) can pre-compile, so a runtime
+            # /v1/prefix registration never compiles on the serving
+            # worker thread (a cold compile there stalls every stream).
             row_shape = (cfg.num_layers, 1, cache_len, cfg.num_kv_heads,
                          cfg.head_dim)
             c1 = KVCache(k=jnp.zeros(row_shape, cfg.activation_dtype),
@@ -252,9 +258,9 @@ class InferenceEngine:
                          index=jnp.zeros((), jnp.int32))
             _, c1 = forward(cfg, params, tokens, positions=positions,
                             cache=c1)
-            return c1.k[:, 0, :plen], c1.v[:, 0, :plen]
+            return c1.k[:, 0], c1.v[:, 0]
 
-        self._prefix_build = jax.jit(prefix_build_fn, static_argnums=(3,))
+        self._prefix_build = jax.jit(prefix_build_fn)
 
         chunk = self.decode_chunk
         max_len = self.max_seq_len
@@ -318,14 +324,28 @@ class InferenceEngine:
                 return v
         return self.view_buckets[-1]
 
-    def warmup(self, rows: Optional[tuple] = None) -> None:
+    def warmup(self, rows: Optional[tuple] = None,
+               prefix_build: bool = False) -> None:
         """Compile prefill (every bucket × every row count in `rows`) + the
         decode chunk ahead of traffic (first-request latency otherwise pays
         1-2 compiles). Slot state is reset afterwards. Default rows covers
         every shape the engine can emit: 1 (single admission) and max_slots
-        (batched burst) — each is a separate XLA program."""
+        (batched burst) — each is a separate XLA program.
+
+        prefix_build=True also compiles the prefix-KV builder per bucket
+        so a runtime /v1/prefix registration never compiles on the
+        serving thread; start servers that register prefixes under
+        traffic with this on (costs len(buckets) extra warmup compiles)."""
         if rows is None:
             rows = (1, self.max_slots) if self.max_slots > 1 else (1,)
+        if prefix_build:
+            for bucket in self.prefill_buckets:
+                toks = np.zeros((1, bucket), np.int32)
+                pos = np.full((1, bucket), self._pad_slot, np.int32)
+                pos[0, 0] = 0
+                with self._mesh_ctx():
+                    self._prefix_build(self.params, jnp.asarray(toks),
+                                       jnp.asarray(pos))
         for bucket in self.prefill_buckets:
             for r in dict.fromkeys(min(r, self.max_slots) for r in rows):
                 padded = np.zeros((r, bucket), np.int32)
@@ -393,14 +413,22 @@ class InferenceEngine:
         pos[0, :plen] = np.arange(plen)
         with self._mesh_ctx():
             pk, pv = self._prefix_build(self.params, jnp.asarray(toks),
-                                        jnp.asarray(pos), plen)
-        self._prefix_cache[key] = (pk, pv)
+                                        jnp.asarray(pos))
+        self._prefix_cache[key] = (pk[:, :plen], pv[:, :plen])
         if len(self._prefix_cache) > self.prefix_cache_size:
             self._prefix_cache.pop(next(iter(self._prefix_cache)))
         if warmup:
+            buffers = None
             for bucket, rows in self.prefix_warmup_shapes(plen):
-                self.warm_prefix_shape(key, bucket, rows)
+                buffers = self.warm_prefix_shape(key, bucket, rows, buffers)
         return plen
+
+    def has_prefix(self, tokens: List[int]) -> bool:
+        """True when register_prefix(tokens) would be a cache hit."""
+        plen = min(len(tokens), self.max_seq_len - 16) // 16 * 16
+        return (plen >= 16
+                and tuple(int(t) for t in tokens[:plen])
+                in self._prefix_cache)
 
     def prefix_warmup_shapes(self, plen: int) -> List[tuple]:
         """(suffix bucket, rows) shapes the splice-prefill can run at for
@@ -410,35 +438,45 @@ class InferenceEngine:
         return [(b, r) for b in self.prefill_buckets if b <= max_suffix
                 for r in rows_set]
 
-    def warm_prefix_shape(self, key: tuple, bucket: int, rows: int) -> None:
+    def warm_prefix_shape(self, key: tuple, bucket: int, rows: int,
+                          buffers: Optional[tuple] = None):
         """Compile ONE prefix splice-prefill shape against THROWAWAY
         pool-cache buffers (the real pool cache may hold live slots;
         warmup writes must not touch it). Exposed shape-at-a-time so the
         serving worker can interleave compiles with decode steps instead
-        of freezing every stream for the whole sweep."""
+        of freezing every stream for the whole sweep.
+
+        Returns the (k, v) buffers that came back from the donated call —
+        pass them to the next warm call so the sweep holds ONE extra
+        pool-sized allocation total, not one per shape (a pool sized to
+        fill HBM would otherwise OOM on the first registration under
+        load). Drop the returned buffers when done."""
         if key not in self._prefix_cache:
-            return  # evicted since queued
+            return buffers  # evicted since queued
         pk, pv = self._prefix_cache[key]
         plen = len(key)
         toks = np.zeros((rows, bucket), np.int32)
         positions = np.full((rows, bucket), self._pad_slot, np.int32)
         positions[:, 0] = plen
-        dummy = KVCache.create(self.cfg, self.max_slots,
-                               self.max_seq_len, trash_slot=True)
-        if self._cache_sharding is not None:
-            dummy = KVCache(
-                k=jax.device_put(dummy.k,
-                                 self._cache_sharding(dummy.k.shape)),
-                v=jax.device_put(dummy.v,
-                                 self._cache_sharding(dummy.v.shape)),
-                index=dummy.index)
+        if buffers is None:
+            dummy = KVCache.create(self.cfg, self.max_slots,
+                                   self.max_seq_len, trash_slot=True)
+            if self._cache_sharding is not None:
+                dummy = KVCache(
+                    k=jax.device_put(dummy.k,
+                                     self._cache_sharding(dummy.k.shape)),
+                    v=jax.device_put(dummy.v,
+                                     self._cache_sharding(dummy.v.shape)),
+                    index=dummy.index)
+            buffers = (dummy.k, dummy.v)
         with self._mesh_ctx():
-            self._prefill_prefix(
-                self.params, dummy.k, dummy.v, pk, pv,
+            _, new_k, new_v, _ = self._prefill_prefix(
+                self.params, buffers[0], buffers[1], pk, pv,
                 jnp.asarray(toks), jnp.asarray(positions),
                 jnp.zeros(rows, jnp.int32), jnp.zeros(rows, jnp.int32),
                 jax.random.key(0), jnp.zeros(rows, jnp.float32),
                 jnp.zeros(rows, jnp.int32), jnp.ones(rows, jnp.float32))
+        return (new_k, new_v)
 
     def _find_prefix(self, prompt: List[int]):
         """Longest registered prefix this prompt starts with, leaving at

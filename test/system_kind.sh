@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# Real-cluster smoke test (reference analog: test/system.sh:40-80, run
+# per-PR by the reference's system-tests workflow): create an actual kind
+# cluster, build + load the controller/SCI/workload images, install the
+# operator, apply the facebook-opt-125m example, wait for Ready through
+# real kubelets, and curl a completion through the served model.
+#
+# This is the one test tier the wire-level test/system.py cannot cover:
+# pod-spec validity, RBAC, hostPath mounts, and CRD schemas asserted
+# against a REAL apiserver instead of the repo's fakes.
+#
+# Requirements: docker, kind, kubectl (skips cleanly where absent — the
+# primary dev image for this repo has none of them; run on a docker host
+# or the kind-smoke CI job). Env:
+#   KEEP=1         leave the cluster up on exit (debugging)
+#   SKIP_BUILD=1   reuse already-loaded images
+#   EXAMPLE=...    example dir to apply (default facebook-opt-125m)
+set -euo pipefail
+
+for tool in docker kind kubectl; do
+  if ! command -v "$tool" >/dev/null 2>&1; then
+    echo "SKIP: $tool not available — the kind smoke needs a docker host"
+    exit 0
+  fi
+done
+
+repo=$(cd "$(dirname "$0")/.." && pwd -P)
+example="${EXAMPLE:-facebook-opt-125m}"
+cluster="runbooks-tpu"
+
+down() {
+  if [ "${KEEP:-}" = "1" ]; then
+    echo "KEEP=1: leaving kind cluster '$cluster' running"
+  else
+    kind delete cluster --name "$cluster" || true
+  fi
+}
+trap down EXIT
+
+if [ "${SKIP_BUILD:-}" != "1" ]; then
+  docker build -t runbooks-tpu/controller-manager:latest \
+    -f "$repo/docker/Dockerfile.controller" "$repo"
+  docker build -t runbooks-tpu/sci:latest \
+    -f "$repo/docker/Dockerfile.sci" "$repo"
+  docker build -t runbooks-tpu/workload:latest \
+    -f "$repo/docker/Dockerfile.workload" "$repo"
+fi
+
+"$repo/install/local-up.sh"
+
+kind load docker-image --name "$cluster" \
+  runbooks-tpu/controller-manager:latest \
+  runbooks-tpu/sci:latest \
+  runbooks-tpu/workload:latest
+
+# Images are loaded node-local; never let kubelet try a registry pull.
+for d in deploy/controller-manager deploy/sci; do
+  kubectl -n runbooks-tpu patch "$d" --type json -p '[
+    {"op": "add",
+     "path": "/spec/template/spec/containers/0/imagePullPolicy",
+     "value": "Never"}]' || true
+done
+
+kubectl -n runbooks-tpu rollout status deploy/controller-manager \
+  --timeout 180s
+kubectl get events -A -w &
+events_pid=$!
+
+kubectl apply -f "$repo/examples/$example/base-model.yaml"
+kubectl apply -f "$repo/examples/$example/base-server.yaml"
+
+# Reference waits on .status.ready for models and servers
+# (test/system.sh:52-53); same contract here.
+kubectl wait --for=jsonpath='{.status.ready}'=true models --all \
+  --timeout 720s
+kubectl wait --for=jsonpath='{.status.ready}'=true servers --all \
+  --timeout 720s
+
+# The Server reconciler names the Service after the Server object
+# (controller/server.py: Service port 80 -> container 8080).
+server_name=$(kubectl get servers -o jsonpath='{.items[0].metadata.name}')
+kubectl port-forward "service/${server_name}" 8080:80 &
+pf_pid=$!
+sleep 3
+
+curl -sf http://localhost:8080/v1/completions \
+  -H "Content-Type: application/json" \
+  -d '{"prompt": "What is your favorite color? ", "max_tokens": 3}' \
+  | tee /dev/stderr | grep -q text_completion
+
+kill "$pf_pid" "$events_pid" 2>/dev/null || true
+echo "KIND SMOKE PASSED"

@@ -178,6 +178,7 @@ def test_1f1b_matches_autodiff_grads():
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_1f1b_train_step_matches_gpipe_step():
     """Full train step through both schedules from identical state: same
     loss metric, same updated params (1F1B is a reschedule, not a

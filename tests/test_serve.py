@@ -10,6 +10,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from runbooks_tpu.models.config import get_config
 from runbooks_tpu.models.transformer import forward, init_params
@@ -306,6 +307,7 @@ def test_http_streaming_sse():
     asyncio.run(drive())
 
 
+@pytest.mark.slow
 def test_engine_chunked_decode_matches_single_step():
     """decode_chunk>1 (the TPU default: K scan steps per host round-trip)
     must emit token-for-token what chunk=1 stepping emits — including
@@ -348,6 +350,7 @@ def test_engine_chunked_decode_capacity_bound():
     assert r.finish_reason == "length"
 
 
+@pytest.mark.slow
 def test_engine_batched_prefill_mixed_buckets():
     """Admissions in one tick group by length bucket; each group prefills
     as one [rows, bucket] call, and results still match the per-request
@@ -370,6 +373,7 @@ def test_engine_batched_prefill_mixed_buckets():
         assert r.output_tokens == greedy_rollout(cfg, params, p, 6), p
 
 
+@pytest.mark.slow
 def test_engine_bucketed_cache_view_parity():
     """Decode through small cache-read views (the HBM-bandwidth
     optimization) emits exactly what the full-cache read emits, across
@@ -431,6 +435,7 @@ def test_engine_prefill_budget_spreads_admission():
     assert int(eng.active.sum()) == 2 and len(eng.queue) == 2
 
 
+@pytest.mark.slow
 def test_engine_shared_prefix_reuse_matches_full_prefill():
     """Requests whose prompt starts with a registered prefix must produce
     EXACTLY the tokens a full prefill would (the cached prefix K/V plus a
@@ -459,6 +464,7 @@ def test_engine_shared_prefix_reuse_matches_full_prefill():
             got.output_tokens, want.output_tokens)
 
 
+@pytest.mark.slow
 def test_engine_prefix_register_rounds_and_evicts():
     cfg = tiny_cfg()
     params = init_params(cfg, jax.random.key(0))
@@ -477,6 +483,7 @@ def test_engine_prefix_register_rounds_and_evicts():
     assert len(eng._prefix_cache) == eng.prefix_cache_size
 
 
+@pytest.mark.slow
 def test_engine_prefix_mixed_with_plain_requests():
     """A tick admitting both prefix-hit and plain requests splits into
     separate prefill groups and all outputs match the no-prefix engine."""
@@ -530,6 +537,7 @@ def test_http_prefix_registration_endpoint():
     asyncio.run(drive())
 
 
+@pytest.mark.slow
 def test_engine_prefix_in_use_survives_eviction_pressure():
     """Admission hits refresh the LRU: the prefix serving live traffic
     must outlive later registrations."""

@@ -6,6 +6,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from runbooks_tpu.models.config import get_config
 from runbooks_tpu.models.transformer import forward, init_params, param_logical_axes
@@ -77,6 +78,7 @@ def test_lora_trains_with_frozen_base():
 # Checkpoint / resume
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path):
     from runbooks_tpu.train.checkpoint import CheckpointManager
 

@@ -68,6 +68,7 @@ def test_train_step_runs_and_learns(mesh_config):
     assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
 
 
+@pytest.mark.slow
 def test_mesh_layouts_agree_numerically():
     ref_losses, _ = run_steps(MeshConfig(data=8, fsdp=1, sequence=1, tensor=1))
     for mc in [MeshConfig(data=1, fsdp=8, sequence=1, tensor=1),

@@ -3,6 +3,8 @@
 import json
 import os
 
+import pytest
+
 from runbooks_tpu.parallel.mesh import MeshConfig
 from runbooks_tpu.train.lora import LoraConfig
 from runbooks_tpu.train.optimizer import OptimizerConfig
@@ -31,6 +33,7 @@ def test_training_writes_artifacts_and_metrics(tmp_path):
     assert "6" in steps
 
 
+@pytest.mark.slow
 def test_training_resumes_from_checkpoint(tmp_path):
     run_training(job(tmp_path, steps=3))
     # Second run with more steps resumes at 3, trains to 6.
