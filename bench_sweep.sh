@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# The staged TPU capture, auditable end-to-end (r4 verdict, Weak #1: the
+# 0.442-MFU headline shipped without a committed transcript; never again).
+# One command at the next relay window:
+#
+#     bash bench_sweep.sh && git add bench_logs BENCH_NOTES.md && git commit
+#
+# Every run's FULL stdout+stderr is teed into bench_logs/<name>.log; the
+# summary table is appended to bench_logs/SUMMARY.md. Runs are strictly
+# serial — only one process may talk to the relay.
+set -uo pipefail
+
+cd "$(dirname "$0")"
+mkdir -p bench_logs
+stamp=$(date -u +%Y%m%dT%H%M%SZ)
+summary=bench_logs/SUMMARY.md
+
+if ! python -c "import socket; socket.create_connection(('127.0.0.1', 8082), 3)" \
+    2>/dev/null; then
+  echo "TPU relay unreachable (127.0.0.1:8082) — not running the sweep." >&2
+  exit 2
+fi
+
+run() {
+  local name="$1"; shift
+  local log="bench_logs/${stamp}-${name}.log"
+  echo "=== ${name}: $* (log: ${log})"
+  # Capture EVERYTHING; the JSON line for the table is the last line that
+  # parses as JSON with a "value" key.
+  ( echo "# ${stamp} ${name}"; echo "# cmd: $*"; "$@" ) 2>&1 | tee "${log}"
+  local line
+  line=$(python - "$log" <<'EOF'
+import json, sys
+last = ""
+for ln in open(sys.argv[1], errors="replace"):
+    ln = ln.strip()
+    if ln.startswith("{"):
+        try:
+            d = json.loads(ln)
+            if "value" in d:
+                last = ln
+        except json.JSONDecodeError:
+            pass
+print(last)
+EOF
+)
+  printf '| %s | `%s` |\n' "${name}" "${line:-NO JSON LINE}" >> "${summary}"
+}
+
+printf '\n## Sweep %s\n\n| run | result |\n|---|---|\n' "${stamp}" >> "${summary}"
+
+# 1. Headline train+serve (the exact line the driver records).
+run baseline python bench.py
+
+# 2. Relay-independent MFU levers, one knob at a time then combined
+#    (BENCH_NOTES r5 §0: bf16 state halves the 5 GB that forced full
+#    remat; save_attn_out skips the flash fwd recompute in bwd).
+RBT_BENCH_SKIP_SERVE=1 run remat-save-attn \
+  env RBT_BENCH_REMAT=save_attn_out python bench.py
+RBT_BENCH_SKIP_SERVE=1 run bf16-state \
+  env RBT_BENCH_PARAM_DTYPE=bfloat16 RBT_BENCH_MU_DTYPE=bfloat16 \
+  python bench.py
+RBT_BENCH_SKIP_SERVE=1 run bf16-state-save-attn \
+  env RBT_BENCH_PARAM_DTYPE=bfloat16 RBT_BENCH_MU_DTYPE=bfloat16 \
+  RBT_BENCH_REMAT=save_attn_out python bench.py
+# With bf16 state the HBM may now fit the FLOPs-cheap end:
+RBT_BENCH_SKIP_SERVE=1 run bf16-state-dots \
+  env RBT_BENCH_PARAM_DTYPE=bfloat16 RBT_BENCH_MU_DTYPE=bfloat16 \
+  RBT_BENCH_REMAT=dots_saveable python bench.py
+
+# 3. Serving: TTFT/decode baseline, chunked-decode ablation, slot /
+#    prefill-budget sweep, shared-prefix reuse (BENCH_NOTES queue).
+run serve-baseline python bench_serve.py
+run serve-chunk1 env RBT_BENCH_CHUNK=1 python bench_serve.py
+run serve-slots4 env RBT_BENCH_SLOTS=4 python bench_serve.py
+run serve-slots16 env RBT_BENCH_SLOTS=16 python bench_serve.py
+run serve-prefix env RBT_BENCH_PROMPT=512 RBT_BENCH_PREFIX=448 \
+  RBT_BENCH_MAXSEQ=1024 python bench_serve.py
+run serve-prefix-ctl env RBT_BENCH_PROMPT=512 RBT_BENCH_MAXSEQ=1024 \
+  python bench_serve.py
+
+echo
+echo "Sweep done. Transcripts in bench_logs/; summary appended to ${summary}."
+echo "Commit them: git add bench_logs BENCH_NOTES.md && git commit"

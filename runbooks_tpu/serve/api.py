@@ -105,6 +105,11 @@ class EngineWorker:
         self._prefix_jobs: list[Tuple[list, Future]] = []
         self._prefix_warm_queue: list[tuple] = []
         self._prefix_warm_buffers = None  # threaded through warm calls
+        # (plen, bucket, rows) shapes already executed once: XLA keys
+        # compiles on shapes, so re-warming them is pure wasted device
+        # work (auto_prefix_chat registers a new KEY per turn but the
+        # same shapes; the jit cache survives engine.reset()).
+        self._warmed_shapes: set = set()
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -152,13 +157,8 @@ class EngineWorker:
                         plen = self.engine.register_prefix(tokens,
                                                            warmup=False)
                         if plen and fresh:
-                            # Re-registrations (LRU refresh) are already
-                            # compiled — re-queueing the sweep would only
-                            # steal device time from live decode ticks.
                             key = tuple(int(t) for t in tokens[:plen])
-                            self._prefix_warm_queue.extend(
-                                (key, b, r) for b, r in
-                                self.engine.prefix_warmup_shapes(plen))
+                            self._queue_warm(key, plen)
                         fut.set_result(plen)
                     except Exception as exc:  # noqa: BLE001
                         if not fut.done():
@@ -178,6 +178,23 @@ class EngineWorker:
                     self._inflight = [(r, f) for r, f in self._inflight
                                       if not r.finished]
                     for req, fut in done:
+                        if req.auto_prefix and req._slot >= 0:
+                            # Multi-turn chat: lift the prompt's KV out of
+                            # the slot before the next admission can
+                            # recycle it (safe here: admissions happen at
+                            # the next step(), and this thread owns the
+                            # engine). Zero forward passes.
+                            try:
+                                plen = self.engine.register_prefix_from_slot(
+                                    req._slot, req.prompt_tokens)
+                                if plen:
+                                    key = tuple(
+                                        int(t)
+                                        for t in req.prompt_tokens[:plen])
+                                    self._queue_warm(key, plen)
+                            except Exception as exc:  # noqa: BLE001
+                                print(f"serve: auto-prefix registration "
+                                      f"failed: {exc!r}", flush=True)
                         if not fut.done():
                             fut.set_result(req)
             except Exception as exc:  # noqa: BLE001 — engine step blew up
@@ -198,6 +215,16 @@ class EngineWorker:
                 # Donated buffers (cache) may have been invalidated by the
                 # failed call — full reset reallocates them.
                 self.engine.reset()
+
+    def _queue_warm(self, key: tuple, plen: int) -> None:
+        """Queue only shapes not yet executed: compiles are keyed on
+        shapes, not prefix keys, so a steady-state chat service (same
+        plen every turn) queues nothing after the first turn."""
+        for b, r in self.engine.prefix_warmup_shapes(plen):
+            sig = (plen, b, r)
+            if sig not in self._warmed_shapes:
+                self._warmed_shapes.add(sig)
+                self._prefix_warm_queue.append((key, b, r))
 
     def _warm_one(self) -> None:
         """Warm one queued prefix shape. Best-effort: a failed speculative
@@ -234,13 +261,16 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   max_seq_len: Optional[int] = None,
                   mesh=None, warmup: bool = False,
                   warm_prefix: bool = False,
+                  auto_prefix_chat: bool = False,
                   prefill_budget: Optional[int] = None,
-                  decode_chunk: Optional[int] = None) -> web.Application:
+                  decode_chunk: Optional[int] = None,
+                  prefix_cache_size: Optional[int] = None) -> web.Application:
     tokenizer = tokenizer or load_tokenizer(None)
     engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
                              max_seq_len=max_seq_len, mesh=mesh,
                              prefill_budget=prefill_budget,
-                             decode_chunk=decode_chunk)
+                             decode_chunk=decode_chunk,
+                             prefix_cache_size=prefix_cache_size)
     if warmup:
         # Pre-compile all buckets before readiness flips. warm_prefix
         # (params.json: warm_prefix) additionally compiles the prefix-KV
@@ -447,6 +477,11 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         reqs, err = _parse_requests(app_, body)
         if err is not None:
             return err
+        if auto_prefix_chat and body.get("_chat"):
+            # Multi-turn chat: this turn's prompt KV becomes the next
+            # turn's prefix (the rendered history strictly extends).
+            for r in reqs:
+                r.auto_prefix = True
         if body.get("stream") and http_request is not None:
             return await _stream(app_, body, reqs, http_request,
                                  chat=bool(body.pop("_chat", False)))
@@ -626,6 +661,10 @@ def main() -> int:
         mesh=mesh,
         warmup=bool(params.get("warmup", True)),
         warm_prefix=bool(params.get("warm_prefix", False)),
+        auto_prefix_chat=bool(params.get("auto_prefix_chat", False)),
+        prefix_cache_size=(int(params["prefix_cache_size"])
+                           if params.get("prefix_cache_size") is not None
+                           else None),
         prefill_budget=(int(params["prefill_budget"])
                         if params.get("prefill_budget") is not None
                         else None))

@@ -55,6 +55,11 @@ class Request:
     top_k: int = 0
     top_p: float = 1.0
     eos_id: Optional[int] = None
+    # Multi-turn hint: after this request finishes, its prompt's KV is
+    # registered as a shared prefix straight from the slot cache (the
+    # next turn's prompt extends this one). Consumed by the serving
+    # worker; no effect inside the engine itself.
+    auto_prefix: bool = False
     # Filled by the engine:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
@@ -83,7 +88,8 @@ class InferenceEngine:
                  max_slots: int = 8, max_seq_len: Optional[int] = None,
                  seed: int = 0, mesh=None,
                  prefill_budget: Optional[int] = None,
-                 decode_chunk: Optional[int] = None):
+                 decode_chunk: Optional[int] = None,
+                 prefix_cache_size: Optional[int] = None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
@@ -178,7 +184,13 @@ class InferenceEngine:
         # B-token shared system prompt this removes a B-bucket prefill
         # per request — the next TTFT lever after bucketed views
         # (BENCH_NOTES r3 queue).
-        self.prefix_cache_size = 4
+        # Default scales with concurrency: under auto_prefix_chat every
+        # live conversation holds an entry between its turns, so a
+        # 4-entry cache behind 8 slots would evict before reuse. Each
+        # entry costs <= [L, plen, kv_h, d] x2 in HBM.
+        self.prefix_cache_size = (prefix_cache_size
+                                  if prefix_cache_size is not None
+                                  else max(4, 2 * max_slots))
         # Ordered dict doubles as the LRU: last key = most recently used
         # (registration AND admission hits refresh), first key evicts.
         self._prefix_cache: "dict[tuple, tuple]" = {}
@@ -382,6 +394,23 @@ class InferenceEngine:
 
     # -- shared-prefix cache -------------------------------------------
 
+    def _prefix_len_for(self, n: int, quantize: bool = False) -> int:
+        """Usable prefix length for an n-token prompt. Explicit
+        registrations (rare, usually pre-traffic) round to a multiple of
+        16 — maximum reuse. The per-turn auto-prefix path passes
+        quantize=True to floor to the prefill bucket set instead, so the
+        compiled splice-program set stays bounded when every chat turn
+        registers a new length (a fresh program per turn would be a
+        serve-time compile stall, ~27 s cold on the v5e relay)."""
+        n = min(n, self.max_seq_len - 16)
+        if not quantize:
+            return n // 16 * 16
+        best = 0
+        for b in self.prefill_buckets:
+            if b <= n:
+                best = b
+        return best
+
     def register_prefix(self, tokens: List[int], warmup: bool = True) -> int:
         """Compute and cache the KV for a shared prompt prefix (e.g. a chat
         system prompt). Returns the cached prefix length (0 = too short).
@@ -399,7 +428,7 @@ class InferenceEngine:
         the TTFT killer (measured: the uncompiled prefix path turned a
         79 ms CPU p50 into 4.7 s). Registration is one-time per prefix
         shape; do it before traffic."""
-        plen = min(len(tokens), self.max_seq_len - 16) // 16 * 16
+        plen = self._prefix_len_for(len(tokens))
         if plen < 16:
             return 0
         key = tuple(int(t) for t in tokens[:plen])
@@ -423,9 +452,39 @@ class InferenceEngine:
                 buffers = self.warm_prefix_shape(key, bucket, rows, buffers)
         return plen
 
+    def register_prefix_from_slot(self, slot: int,
+                                  tokens: List[int]) -> int:
+        """Register tokens[:plen] as a prefix by COPYING its already-
+        computed KV out of a slot's pool cache — no forward pass at all.
+
+        The zero-cost path for multi-turn chat: a finished request's
+        prompt KV is sitting in its slot (prefill wrote positions
+        0..m-1; later decode writes land at higher positions and don't
+        disturb it), and the next turn's prompt extends this one. Call
+        between the request finishing and the slot's next admission
+        (the engine is single-threaded, so 'right after step()' is safe
+        — the serving worker does exactly that).
+
+        Returns the cached length (0 = too short / already cached)."""
+        plen = self._prefix_len_for(len(tokens), quantize=True)
+        if plen < 16:
+            return 0
+        key = tuple(int(t) for t in tokens[:plen])
+        if key in self._prefix_cache:
+            self._prefix_cache[key] = self._prefix_cache.pop(key)
+            return 0
+        # Eager slices materialize fresh buffers, so later donation of
+        # the pool cache cannot invalidate the cached prefix.
+        pk = self.cache.k[:, slot, :plen]
+        pv = self.cache.v[:, slot, :plen]
+        self._prefix_cache[key] = (pk, pv)
+        if len(self._prefix_cache) > self.prefix_cache_size:
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+        return plen
+
     def has_prefix(self, tokens: List[int]) -> bool:
         """True when register_prefix(tokens) would be a cache hit."""
-        plen = min(len(tokens), self.max_seq_len - 16) // 16 * 16
+        plen = self._prefix_len_for(len(tokens))
         return (plen >= 16
                 and tuple(int(t) for t in tokens[:plen])
                 in self._prefix_cache)

@@ -308,6 +308,37 @@ def phase_serve() -> None:
         streamed, body["choices"][0]["text"])
     print("ok: /v1/completions streamed", len(events) - 1, "chunks")
 
+    # Shared-prefix registration: the same completion behind a registered
+    # prefix must reuse the cached KV and produce identical text.
+    sys_prompt = "You are a helpful assistant. " * 4
+    req = urllib.request.Request(
+        f"http://localhost:{serve_port}/v1/prefix",
+        data=json.dumps({"prompt": sys_prompt}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        plen = json.load(resp)["cached_prefix_len"]
+    assert plen >= 16, plen
+
+    def completion(prompt):
+        req = urllib.request.Request(
+            f"http://localhost:{serve_port}/v1/completions",
+            data=json.dumps({"prompt": prompt, "max_tokens": 6,
+                             "temperature": 0.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.load(resp)["choices"][0]["text"]
+
+    text_prefixed = completion(sys_prompt + "Hello")
+    with urllib.request.urlopen(
+            f"http://localhost:{serve_port}/metrics", timeout=30) as resp:
+        metrics = resp.read().decode()
+    reused = [int(ln.split()[-1]) for ln in metrics.splitlines()
+              if ln.startswith("serve_prefix_tokens_reused_total")]
+    assert reused and reused[0] >= plen, metrics
+    assert isinstance(text_prefixed, str)
+    print(f"ok: /v1/prefix registered {plen} tokens and completions "
+          f"reused {reused[0]}")
+
 
 def main() -> int:
     import tempfile

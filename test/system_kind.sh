@@ -45,13 +45,18 @@ if [ "${SKIP_BUILD:-}" != "1" ]; then
   docker build -t runbooks-tpu/workload:latest \
     -f "$repo/docker/Dockerfile.workload" "$repo"
 fi
+# Workload pods reference the image by tag from the examples; a :latest
+# tag defaults imagePullPolicy to Always and kubelet would try a
+# registry pull of a node-loaded image. Retag :smoke (non-latest =>
+# IfNotPresent) and point the example manifests at it.
+docker tag runbooks-tpu/workload:latest runbooks-tpu/workload:smoke
 
 "$repo/install/local-up.sh"
 
 kind load docker-image --name "$cluster" \
   runbooks-tpu/controller-manager:latest \
   runbooks-tpu/sci:latest \
-  runbooks-tpu/workload:latest
+  runbooks-tpu/workload:smoke
 
 # Images are loaded node-local; never let kubelet try a registry pull.
 for d in deploy/controller-manager deploy/sci; do
@@ -66,8 +71,13 @@ kubectl -n runbooks-tpu rollout status deploy/controller-manager \
 kubectl get events -A -w &
 events_pid=$!
 
-kubectl apply -f "$repo/examples/$example/base-model.yaml"
-kubectl apply -f "$repo/examples/$example/base-server.yaml"
+workdir=$(mktemp -d)
+sed 's#runbooks-tpu/workload:latest#runbooks-tpu/workload:smoke#' \
+  "$repo/examples/$example/base-model.yaml" > "$workdir/model.yaml"
+sed 's#runbooks-tpu/workload:latest#runbooks-tpu/workload:smoke#' \
+  "$repo/examples/$example/base-server.yaml" > "$workdir/server.yaml"
+kubectl apply -f "$workdir/model.yaml"
+kubectl apply -f "$workdir/server.yaml"
 
 # Reference waits on .status.ready for models and servers
 # (test/system.sh:52-53); same contract here.

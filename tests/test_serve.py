@@ -553,3 +553,69 @@ def test_engine_prefix_in_use_survives_eviction_pressure():
         eng.register_prefix([100 + i] * 16)
     assert eng.prefix_tokens_reused == 16 * eng.prefix_cache_size
     assert tuple(hot) in eng._prefix_cache, "hot prefix was evicted"
+
+
+def test_engine_register_prefix_from_slot_matches_full_prefill():
+    """Zero-forward prefix registration: KV copied out of a finished
+    request's slot must serve later longer prompts with EXACTLY the
+    outputs a full prefill produces."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    turn1 = list(range(2, 20))            # 18 tokens -> bucket 16 cached
+    turn2 = turn1 + [30, 31, 32]
+
+    ref = InferenceEngine(cfg, params, max_slots=2)
+    want = Request(prompt_tokens=list(turn2), max_tokens=6)
+    ref.generate([want])
+
+    eng = InferenceEngine(cfg, params, max_slots=2)
+    first = Request(prompt_tokens=list(turn1), max_tokens=4)
+    eng.generate([first])
+    assert first._slot >= 0
+    assert eng.register_prefix_from_slot(first._slot, turn1) == 16
+    got = Request(prompt_tokens=list(turn2), max_tokens=6)
+    eng.generate([got])
+    assert eng.prefix_tokens_reused == 16
+    assert got.output_tokens == want.output_tokens
+
+
+def test_http_chat_auto_prefix_multi_turn():
+    """auto_prefix_chat: turn N's prompt KV is registered from its slot
+    and turn N+1 (whose rendered prompt extends it) reuses it, with
+    identical answers to a server without the feature."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from runbooks_tpu.serve.api import create_server
+
+    cfg = dataclasses.replace(tiny_cfg(), max_seq_len=256)
+    params = init_params(cfg, jax.random.key(0))
+
+    async def converse(app):
+        msgs = [{"role": "system",
+                 "content": "Be concise and always answer in English."},
+                {"role": "user", "content": "hello there"}]
+        answers = []
+        async with TestClient(TestServer(app)) as client:
+            for turn in range(2):
+                r = await client.post("/v1/chat/completions", json={
+                    "messages": msgs, "max_tokens": 4, "temperature": 0.0})
+                assert r.status == 200
+                body = await r.json()
+                text = body["choices"][0]["message"]["content"]
+                answers.append(text)
+                msgs.append({"role": "assistant", "content": text})
+                msgs.append({"role": "user", "content": "and again"})
+            # Worker registers from the slot after each completion; by
+            # the second turn the first turn's prompt must have been
+            # reused (rendered history strictly extends it).
+            eng = app["worker"].engine
+            return answers, eng.prefix_tokens_reused
+
+    app_off = create_server(cfg, params, max_slots=2)
+    want, reused_off = asyncio.run(converse(app_off))
+    assert reused_off == 0
+
+    app_on = create_server(cfg, params, max_slots=2, auto_prefix_chat=True)
+    got, reused_on = asyncio.run(converse(app_on))
+    assert reused_on > 0, "second turn did not reuse the first turn's KV"
+    assert got == want
