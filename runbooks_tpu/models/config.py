@@ -94,8 +94,10 @@ class ModelConfig:
     # gather forces an involuntary full-remat reshard. Measured on the
     # 8-way virtual mesh (fsdp2 x seq2 x tp2 train step): one-hot removes
     # the all-to-all + all 3 collective-permutes and 3 all-gathers from
-    # the compiled HLO. None = auto (one-hot exactly when the active mesh
-    # has tensor > 1); True/False force.
+    # the compiled HLO; a sequence-sharded mesh hits the same involuntary
+    # reshard through the gather's scatter-add transpose. None = auto
+    # (one-hot when the active mesh has tensor > 1 OR sequence > 1);
+    # True/False force.
     embed_one_hot: Optional[bool] = None
 
     # Dtypes

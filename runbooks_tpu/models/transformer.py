@@ -241,6 +241,26 @@ def _activation(cfg: ModelConfig, x: jax.Array) -> jax.Array:
     return jax.nn.relu(x)
 
 
+def _auto_embed_one_hot(cfg: ModelConfig, has_cache: bool) -> bool:
+    """One-hot-vs-gather auto rule, shared by forward() and the 1F1B
+    embed (they must not drift). One-hot when the mesh tensor-shards the
+    vocab (the gather forces a full-remat reshard), or — training only —
+    when the sequence axis is sharded (the gather's scatter-add TRANSPOSE
+    hits the same involuntary-remat path; a cached/serving forward has no
+    backward, and the one-hot there would materialize a [b, s, vocab]
+    tensor for nothing)."""
+    if cfg.embed_one_hot is not None:
+        return cfg.embed_one_hot
+    from runbooks_tpu.parallel.sharding import _current_mesh
+
+    m0 = _current_mesh()
+    if m0 is None:
+        return False
+    if int(m0.shape.get("tensor", 1)) > 1:
+        return True
+    return not has_cache and int(m0.shape.get("sequence", 1)) > 1
+
+
 def resolve_attention_impl(cfg: ModelConfig) -> str:
     """Resolve cfg.attention_impl ("auto" included) to a concrete impl for
     the no-cache (training) path: ring when the active mesh is
@@ -516,15 +536,7 @@ def forward(
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
                                          (b, s))
 
-    use_one_hot = cfg.embed_one_hot
-    if use_one_hot is None:
-        # Auto: under tensor parallelism the vocab dim is TP-sharded and
-        # the one-hot matmul partitions cleanly where the gather forces a
-        # full-remat reshard (see ModelConfig.embed_one_hot).
-        from runbooks_tpu.parallel.sharding import _current_mesh
-
-        m0 = _current_mesh()
-        use_one_hot = m0 is not None and int(m0.shape.get("tensor", 1)) > 1
+    use_one_hot = _auto_embed_one_hot(cfg, has_cache=cache is not None)
     if use_one_hot:
         one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=ad)
         x = jnp.einsum("bsv,vh->bsh", one_hot, params["embed"].astype(ad),
@@ -670,11 +682,7 @@ def loss_and_grads_1f1b(
     nl_params = {k: v for k, v in params.items() if k != "layers"}
 
     def embed_fn(nl):
-        use_one_hot = cfg.embed_one_hot
-        if use_one_hot is None:
-            m0 = _current_mesh()
-            use_one_hot = (m0 is not None
-                           and int(m0.shape.get("tensor", 1)) > 1)
+        use_one_hot = _auto_embed_one_hot(cfg, has_cache=False)
         if use_one_hot:
             one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=ad)
             x = jnp.einsum("bsv,vh->bsh", one_hot, nl["embed"].astype(ad),

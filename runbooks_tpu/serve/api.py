@@ -217,13 +217,17 @@ class EngineWorker:
                 self.engine.reset()
 
     def _queue_warm(self, key: tuple, plen: int) -> None:
-        """Queue only shapes not yet executed: compiles are keyed on
-        shapes, not prefix keys, so a steady-state chat service (same
-        plen every turn) queues nothing after the first turn."""
+        """Queue only shapes not already executed or in flight: compiles
+        are keyed on shapes, not prefix keys, so a steady-state chat
+        service (same plen every turn) queues nothing after the first
+        turn. Shapes join _warmed_shapes only once their warm SUCCEEDS
+        (_warm_one) — marking at queue time would permanently skip shapes
+        whose warm got dropped (key evicted first, sweep failure, crash
+        reset), leaving the compile stall for the first live admission."""
+        queued = {(len(k), b, r) for k, b, r in self._prefix_warm_queue}
         for b, r in self.engine.prefix_warmup_shapes(plen):
             sig = (plen, b, r)
-            if sig not in self._warmed_shapes:
-                self._warmed_shapes.add(sig)
+            if sig not in self._warmed_shapes and sig not in queued:
                 self._prefix_warm_queue.append((key, b, r))
 
     def _warm_one(self) -> None:
@@ -234,6 +238,8 @@ class EngineWorker:
         try:
             self._prefix_warm_buffers = self.engine.warm_prefix_shape(
                 key, bucket, rows, self._prefix_warm_buffers)
+            if key in self.engine._prefix_cache:  # actually executed
+                self._warmed_shapes.add((len(key), bucket, rows))
         except Exception as exc:  # noqa: BLE001
             print(f"serve: prefix warmup shape ({bucket}x{rows}) failed, "
                   f"dropping remaining sweep: {exc!r}", flush=True)

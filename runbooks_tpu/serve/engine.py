@@ -411,6 +411,15 @@ class InferenceEngine:
                 best = b
         return best
 
+    def _prefix_cache_hit(self, key: tuple) -> None:
+        """LRU refresh: most-recently-used keys live at the dict's end."""
+        self._prefix_cache[key] = self._prefix_cache.pop(key)
+
+    def _prefix_cache_put(self, key: tuple, kv: tuple) -> None:
+        self._prefix_cache[key] = kv
+        if len(self._prefix_cache) > self.prefix_cache_size:
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+
     def register_prefix(self, tokens: List[int], warmup: bool = True) -> int:
         """Compute and cache the KV for a shared prompt prefix (e.g. a chat
         system prompt). Returns the cached prefix length (0 = too short).
@@ -433,7 +442,7 @@ class InferenceEngine:
             return 0
         key = tuple(int(t) for t in tokens[:plen])
         if key in self._prefix_cache:
-            self._prefix_cache[key] = self._prefix_cache.pop(key)  # refresh
+            self._prefix_cache_hit(key)
             return plen
         bucket = self._bucket_for(plen)
         toks = np.zeros((1, bucket), np.int32)
@@ -443,9 +452,7 @@ class InferenceEngine:
         with self._mesh_ctx():
             pk, pv = self._prefix_build(self.params, jnp.asarray(toks),
                                         jnp.asarray(pos))
-        self._prefix_cache[key] = (pk[:, :plen], pv[:, :plen])
-        if len(self._prefix_cache) > self.prefix_cache_size:
-            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+        self._prefix_cache_put(key, (pk[:, :plen], pv[:, :plen]))
         if warmup:
             buffers = None
             for bucket, rows in self.prefix_warmup_shapes(plen):
@@ -471,15 +478,13 @@ class InferenceEngine:
             return 0
         key = tuple(int(t) for t in tokens[:plen])
         if key in self._prefix_cache:
-            self._prefix_cache[key] = self._prefix_cache.pop(key)
+            self._prefix_cache_hit(key)
             return 0
         # Eager slices materialize fresh buffers, so later donation of
         # the pool cache cannot invalidate the cached prefix.
         pk = self.cache.k[:, slot, :plen]
         pv = self.cache.v[:, slot, :plen]
-        self._prefix_cache[key] = (pk, pv)
-        if len(self._prefix_cache) > self.prefix_cache_size:
-            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+        self._prefix_cache_put(key, (pk, pv))
         return plen
 
     def has_prefix(self, tokens: List[int]) -> bool:
@@ -671,7 +676,7 @@ class InferenceEngine:
                 # Admission hit refreshes the LRU position: the prefix
                 # serving live traffic must not be the one evicted.
                 pk, pv = self._prefix_cache[pkey]
-                self._prefix_cache[pkey] = self._prefix_cache.pop(pkey)
+                self._prefix_cache_hit(pkey)
                 first, new_k, new_v, self.rng = self._prefill_prefix(
                     self.params, self.cache.k, self.cache.v, pk, pv, *args)
                 self.prefix_tokens_reused += plen * n
