@@ -142,4 +142,51 @@ if __name__ == "__main__":
                 result["serve_platform"] = serve.get("platform")
             for err in serve.get("bench_errors", []):
                 result.setdefault("bench_errors", []).append(f"serve: {err}")
+        if os.environ.get("RBT_BENCH_SKIP_QUANT") != "1" \
+                and os.environ.get("RBT_BENCH_SKIP_SERVE") != "1":
+            # Quantized-serving smoke: bf16 vs int8 weights + int8 KV at a
+            # size where decode is genuinely bandwidth-bound (the default
+            # debug model fits in cache and shows only dequant overhead).
+            # Tiny token counts keep the pair of runs a few minutes on CPU.
+            here = os.path.dirname(os.path.abspath(__file__))
+            quant_model = os.environ.get("RBT_BENCH_QUANT_MODEL",
+                                         "bench-410m")
+            shape = {
+                "RBT_BENCH_MODEL": quant_model,
+                "RBT_BENCH_PROMPT": "16", "RBT_BENCH_MAXTOK": "16",
+                "RBT_BENCH_REQUESTS": "8", "RBT_BENCH_MAXSEQ": "128",
+            }
+            import benchkit as _bk
+
+            def _measure(quantize):
+                env = {**shape, "RBT_BENCH_QUANTIZE": quantize}
+                saved = {k: os.environ.get(k) for k in env}
+                os.environ.update(env)
+                try:
+                    return _bk.measure_outer(
+                        os.path.join(here, "bench_serve.py"),
+                        f"serve decode ({quantize})", "ms")
+                finally:
+                    for k, v in saved.items():
+                        if v is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = v
+
+            base = _measure("none")
+            q8 = _measure("int8")
+            if base.get("decode_tokens_per_sec") \
+                    and q8.get("decode_tokens_per_sec"):
+                b = base["decode_tokens_per_sec"]
+                q = q8["decode_tokens_per_sec"]
+                result["serve_quant_model"] = quant_model
+                result["serve_decode_tok_s_bf16_quant_model"] = b
+                result["serve_decode_tok_s_int8_quant_model"] = q
+                result["serve_int8_decode_speedup"] = round(q / b, 3)
+                result["serve_int8_weight_bytes"] = q8.get("weight_bytes")
+                result["serve_int8_kv_bytes"] = q8.get("kv_cache_bytes")
+            for err in (base.get("bench_errors", [])
+                        + q8.get("bench_errors", [])):
+                result.setdefault("bench_errors", []).append(
+                    f"serve-quant: {err}")
         print(json.dumps(result))

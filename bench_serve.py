@@ -9,6 +9,13 @@ Same outer/inner structure as bench.py (see benchkit.py): the orchestrator
 preflights the TPU relay, subprocesses the real bench with a timeout, falls
 back to CPU, and always prints ONE JSON line. Knobs: RBT_BENCH_MODEL /
 RBT_BENCH_SLOTS / RBT_BENCH_REQUESTS / RBT_BENCH_PROMPT / RBT_BENCH_MAXTOK.
+
+RBT_BENCH_QUANTIZE={none,int8,int4} quantizes the weights (blockwise
+weight-only, ops/quantization.py) AND switches the KV cache to int8 +
+per-slot-per-head scales — the serving fast path. The JSON reports
+weight_bytes and kv_cache_bytes next to decode tok/s and TTFT so the
+bandwidth-for-throughput trade is auditable (decode is memory-bound:
+fewer bytes streamed per token = more tok/s at equal batch).
 """
 
 from __future__ import annotations
@@ -55,11 +62,28 @@ def inner() -> None:
     # prefills only the (prompt_len - P)-token suffix. 0 = off.
     prefix_len = int(os.environ.get("RBT_BENCH_PREFIX", 0))
 
-    cfg = get_config(model, param_dtype="bfloat16" if on_tpu else "float32")
+    # Quantized serving axis: int8/int4 weight-only + int8 KV cache.
+    quantize = os.environ.get("RBT_BENCH_QUANTIZE", "none")
+    # The bf16-vs-quantized comparison must hold weights dtype-equal at the
+    # baseline: bf16 params on both platforms (the serving dtype), so the
+    # quantized speedup is bandwidth, not a f32->bf16 cast artifact.
+    cfg = get_config(model, param_dtype="bfloat16")
     params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+    if quantize != "none":
+        from runbooks_tpu.ops.quantization import quantize_params
+
+        params = quantize_params(params, quantize)
+    from runbooks_tpu.ops.quantization import tree_weight_bytes
+
+    weight_bytes = tree_weight_bytes(params)
     engine = InferenceEngine(cfg, params, max_slots=slots,
                              max_seq_len=max_seq or None,
-                             decode_chunk=chunk)
+                             decode_chunk=chunk,
+                             quantize_kv=quantize != "none")
+    kv_cache_bytes = sum(
+        x.nbytes for x in (engine.cache.k, engine.cache.v,
+                           engine.cache.k_scale, engine.cache.v_scale)
+        if x is not None)
     engine.warmup()
     worker = EngineWorker(engine)
 
@@ -115,7 +139,8 @@ def inner() -> None:
     # distinguishable from any real measurement.
     print(json.dumps({
         "metric": f"{model} serve TTFT p50 ({n_requests} reqs, "
-                  f"{slots} slots, prompt {prompt_len})",
+                  f"{slots} slots, prompt {prompt_len}, "
+                  f"quantize {quantize})",
         "value": round(ttft_p50_ms, 1),
         "unit": "ms",
         "vs_baseline": round(250.0 / max(ttft_p50_ms, 1e-6), 4),
@@ -124,6 +149,9 @@ def inner() -> None:
         "decode_tokens_per_sec": round(total_tokens / wall, 1),
         "decode_chunk": engine.decode_chunk,
         "prefix_tokens_reused": engine.prefix_tokens_reused,
+        "quantize": quantize,
+        "weight_bytes": weight_bytes,
+        "kv_cache_bytes": kv_cache_bytes,
         "platform": jax.default_backend(),
         "device": str(device),
     }))

@@ -79,6 +79,14 @@ run serve-prefix env RBT_BENCH_PROMPT=512 RBT_BENCH_PREFIX=448 \
 run serve-prefix-ctl env RBT_BENCH_PROMPT=512 RBT_BENCH_MAXSEQ=1024 \
   python bench_serve.py
 
+# 4. Quantized serving fast path (int8/int4 weight-only + int8 KV): decode
+#    is bandwidth-bound, so fewer bytes streamed per token = more tok/s at
+#    equal batch, and the int4 tier is what fits 70B on a v5e-8. Same
+#    model/shape across the three runs so the ratio is the whole story.
+run serve-quant-none env RBT_BENCH_QUANTIZE=none python bench_serve.py
+run serve-quant-int8 env RBT_BENCH_QUANTIZE=int8 python bench_serve.py
+run serve-quant-int4 env RBT_BENCH_QUANTIZE=int4 python bench_serve.py
+
 echo
 echo "Sweep done. Transcripts in bench_logs/; summary appended to ${summary}."
 echo "Commit them: git add bench_logs BENCH_NOTES.md && git commit"

@@ -40,3 +40,6 @@ REASON_BASEMODEL_NOT_FOUND = "BaseModelNotFound"
 REASON_BASEMODEL_NOT_READY = "BaseModelNotReady"
 REASON_SLICE_PENDING = "PodSlicePending"
 REASON_SLICE_RUNNING = "PodSliceRunning"
+# spec.params validation failed (e.g. quantize outside none|int8|int4) —
+# terminal until the spec changes, like the reference's webhook rejections.
+REASON_INVALID_PARAMS = "InvalidParams"

@@ -50,6 +50,30 @@ def params_env(params: dict) -> List[dict]:
             for k, v in sorted(params_to_env(params).items())]
 
 
+# Enum-valued spec.params keys with their allowed values. The params dict
+# is otherwise free-form (it flows verbatim into the params.json ConfigMap
+# + PARAM_* env — mount_params), but a typo'd `quantize: int3` would
+# otherwise surface only as a crash-looping serve container behind a
+# never-ready Deployment; validating at reconcile time turns it into a
+# visible condition. `quantize` mirrors the reference's Server contract
+# (reference: examples/llama2-70b/server.yaml `quantize: int4`), consumed
+# by serve/api.load_model and models/loader.py.
+ENUM_PARAMS = {
+    "quantize": ("none", "int8", "int4"),
+    "source": ("huggingface", "dir", "random"),
+}
+
+
+def validate_params(params: dict) -> Optional[str]:
+    """First validation error in a spec.params dict, or None when clean."""
+    for key, allowed in ENUM_PARAMS.items():
+        val = params.get(key)
+        if val is not None and str(val) not in allowed:
+            return (f"spec.params.{key}: {val!r} is not one of "
+                    f"{'|'.join(allowed)}")
+    return None
+
+
 # ---------------------------------------------------------------------------
 # Jobs
 # ---------------------------------------------------------------------------

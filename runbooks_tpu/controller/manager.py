@@ -55,6 +55,22 @@ DEPENDENT_INDEXES: Dict[str, List[tuple]] = {
 }
 
 
+def _is_connectivity_error(exc: BaseException) -> bool:
+    """Apiserver-connectivity-shaped errors the watch loop should retry
+    forever: socket/OS errors (ConnectionError, socket.timeout, and
+    urllib.error.URLError are all OSError subclasses), bad/truncated HTTP
+    responses, timeouts, and apiserver HTTP-status errors (the wire
+    client's typed ApiServerError — a sustained 503 during a rolling
+    apiserver restart must keep the old retry-forever behavior, not count
+    as a deterministic bug). Everything else is presumed a bug."""
+    import http.client
+
+    from runbooks_tpu.k8s.fake import ApiServerError
+
+    return isinstance(exc, (OSError, http.client.HTTPException,
+                            TimeoutError, ApiServerError))
+
+
 class Manager:
     def __init__(self, ctx: Ctx, reconcilers: List[Reconciler]):
         self.ctx = ctx
@@ -141,23 +157,36 @@ class Manager:
     # -- watch-driven loop (deployment path) ---------------------------
 
     def run(self, stop: threading.Event, resync_seconds: float = 30.0,
-            max_backoff: float = 30.0) -> None:
-        """Watch-driven loop. Survives apiserver failure: any transient
-        error (refused/reset connections on watch, GET, or dependent LIST)
-        logs, backs off exponentially, re-subscribes the watches, and keeps
-        going — matching controller-runtime's retry semantics. Before r5
-        one unguarded LIST killed this thread while the leader lease kept
-        renewing (a dead leader that looked alive)."""
+            max_backoff: float = 30.0, crash_after: int = 3) -> None:
+        """Watch-driven loop. Survives apiserver failure: a CONNECTIVITY-
+        shaped error (refused/reset connections on watch, GET, or dependent
+        LIST — OSError/ConnectionError/http) logs, backs off exponentially,
+        re-subscribes the watches, and keeps going — matching
+        controller-runtime's retry semantics. Before r5 one unguarded LIST
+        killed this thread while the leader lease kept renewing (a dead
+        leader that looked alive).
+
+        Anything else is treated as a bug: after `crash_after` CONSECUTIVE
+        IDENTICAL non-connectivity failures the loop re-raises so the
+        process crashes and restarts — a deterministic programming error
+        retried forever with backoff is a silently dead controller (ADVICE
+        r5). The stop event is honored both in the healthy sleep and the
+        failure backoff, and close_subs JOINS the wire readers so no
+        watcher thread outlives the loop (the `watch X: reconnecting`
+        prints after pytest teardown)."""
         subs: Dict[str, object] = {}
 
-        def close_subs() -> None:
+        def close_subs(join: bool = False) -> None:
             # Old subscriptions must be closed, not just dropped: the wire
             # client's reader thread reconnects forever and its queue keeps
             # filling — one leaked thread + queue per apiserver hiccup.
             for sub in subs.values():
                 close = getattr(sub, "close", None)
                 if close is not None:
-                    close()
+                    try:
+                        close(join=join)
+                    except TypeError:  # fake subs take no join arg
+                        close()
             subs.clear()
 
         # (kind, ns, name) -> monotonic due-time; the workqueue analog for
@@ -166,6 +195,8 @@ class Manager:
         pending: Dict[tuple, float] = {}
         last_resync = 0.0
         backoff = 0.5
+        last_bug_sig: Optional[tuple] = None
+        bug_streak = 0
         while not stop.is_set():
             try:
                 if not subs:
@@ -200,9 +231,26 @@ class Manager:
                                                 raise_errors=False)
                     worked = True
                 backoff = 0.5  # healthy iteration: reset
+                last_bug_sig, bug_streak = None, 0
                 if not worked:
                     time.sleep(0.02)
-            except Exception:  # noqa: BLE001 — apiserver down: retry
+            except Exception as exc:  # noqa: BLE001
+                if not _is_connectivity_error(exc):
+                    # Not connectivity-shaped: likely a real bug (the
+                    # per-reconciler guards already swallow reconcile
+                    # errors, so an exception here is the loop's own
+                    # plumbing). Retry a couple of times in case it is a
+                    # weirdly-dressed transient, but crash on a streak of
+                    # identical failures so the bug surfaces via process
+                    # restart instead of an infinitely backing-off log.
+                    sig = (type(exc), str(exc))
+                    bug_streak = bug_streak + 1 if sig == last_bug_sig else 1
+                    last_bug_sig = sig
+                    if bug_streak >= crash_after:
+                        close_subs(join=True)
+                        raise
+                else:
+                    last_bug_sig, bug_streak = None, 0
                 self._log_apiserver_error("watch loop")
                 # Old subscriptions may be dead after an apiserver restart;
                 # close them so the next iteration re-subscribes, and the
@@ -211,7 +259,7 @@ class Manager:
                 last_resync = 0.0
                 stop.wait(backoff)
                 backoff = min(backoff * 2, max_backoff)
-        close_subs()
+        close_subs(join=True)
 
     def process_event(self, kind: str, obj: dict,
                       pending: Optional[Dict[tuple, float]] = None) -> None:

@@ -29,6 +29,7 @@ from runbooks_tpu.controller.common import (
     reconcile_params_configmap,
     reconcile_service_account,
     resolve_env,
+    validate_params,
 )
 from runbooks_tpu.controller.manager import Ctx, Result
 from runbooks_tpu.k8s import objects as ko
@@ -46,6 +47,16 @@ class ModelReconciler:
         # Image gate: either preset or produced by the build reconciler.
         if not model.image:
             return Result(requeue_after=1.0)
+
+        err = validate_params(model.params)
+        if err is not None:
+            # Invalid spec.params (e.g. quantize: int3, source: hf): a
+            # visible condition beats a crash-looping loader Job. Terminal
+            # until the spec changes — no requeue.
+            model.set_condition(cond.COMPLETE, False,
+                                cond.REASON_INVALID_PARAMS, err)
+            model.commit_status(ctx.client)
+            return Result()
 
         reconcile_params_configmap(ctx.client, model)
 

@@ -28,6 +28,7 @@ from runbooks_tpu.controller.common import (
     reconcile_params_configmap,
     reconcile_service_account,
     resolve_env,
+    validate_params,
 )
 from runbooks_tpu.controller.manager import Ctx, Result
 from runbooks_tpu.k8s import objects as ko
@@ -42,6 +43,15 @@ class ServerReconciler:
         server = Server(raw)
         if not server.image:
             return Result(requeue_after=1.0)
+        err = validate_params(server.params)
+        if err is not None:
+            # Invalid spec.params (e.g. quantize: int3): surface a condition
+            # instead of shipping a params.json the serve container will
+            # crash-loop on. Terminal until the spec changes — no requeue.
+            server.set_condition(cond.SERVING, False,
+                                 cond.REASON_INVALID_PARAMS, err)
+            server.commit_status(ctx.client)
+            return Result()
         reconcile_params_configmap(ctx.client, server)
 
         if not server.model_ref:

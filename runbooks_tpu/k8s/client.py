@@ -28,6 +28,7 @@ import yaml
 from runbooks_tpu.k8s import objects as ko
 from runbooks_tpu.k8s.fake import (
     AlreadyExists,
+    ApiServerError,
     Conflict,
     NotFound,
     Subscription,
@@ -172,7 +173,8 @@ class K8sClient:
                 if "AlreadyExists" in detail:
                     raise AlreadyExists(detail)
                 raise Conflict(detail)
-            raise RuntimeError(f"{method} {url} -> {e.code}: {detail}")
+            raise ApiServerError(f"{method} {url} -> {e.code}: {detail}",
+                                 code=e.code)
 
     # -- ApiClient interface -------------------------------------------
 
@@ -363,5 +365,7 @@ class K8sClient:
                     if sub.closed.wait(2):
                         return
 
-        threading.Thread(target=reader, daemon=True).start()
+        t = threading.Thread(target=reader, daemon=True)
+        sub.reader_thread = t
+        t.start()
         return sub

@@ -34,6 +34,17 @@ class AlreadyExists(Exception):
     pass
 
 
+class ApiServerError(Exception):
+    """Non-404/409 HTTP status from the apiserver (e.g. 500/503 during a
+    rolling restart). Typed — not a bare RuntimeError — so the manager's
+    watch loop can classify it as connectivity-shaped and retry instead of
+    counting it toward its crash-after-N-identical-bugs heuristic."""
+
+    def __init__(self, message: str, code: int = 0):
+        super().__init__(message)
+        self.code = code
+
+
 def _key(api_version: str, kind: str, namespace: str, name: str) -> Key:
     return (api_version, kind, namespace, name)
 
@@ -71,6 +82,11 @@ class Subscription:
         self.q: "queue.Queue[Tuple[str, Obj]]" = queue.Queue()
         self.closed = threading.Event()
         self._closers: List = []
+        # The wire client parks its reader thread here so close(join=True)
+        # can wait for it to actually exit (a closed-but-still-winding-down
+        # reader printing "reconnecting" after pytest teardown is noise
+        # that reads like a hang).
+        self.reader_thread: "threading.Thread | None" = None
 
     def put(self, event: str, obj: Obj) -> None:
         if not self.closed.is_set():
@@ -94,7 +110,7 @@ class Subscription:
         except ValueError:
             pass
 
-    def close(self) -> None:
+    def close(self, join: bool = False, timeout: float = 3.0) -> None:
         self.closed.set()
         closers, self._closers = self._closers, []
         for fn in closers:
@@ -102,6 +118,9 @@ class Subscription:
                 fn()
             except Exception:  # noqa: BLE001
                 pass
+        if join and self.reader_thread is not None \
+                and self.reader_thread is not threading.current_thread():
+            self.reader_thread.join(timeout=timeout)
 
     def poll(self, timeout: float = 0.0):
         try:

@@ -104,6 +104,19 @@ class ModelConfig:
     dtype: str = "bfloat16"           # activation dtype
     param_dtype: str = "float32"      # master param dtype
 
+    # Weight-only quantization applied at load time (ops/quantization.py):
+    # "none" | "int8" | "int4" (blockwise symmetric; int4 packs two
+    # nibbles/byte). Mirrors the reference's Server `quantize: int4`
+    # contract (reference: examples/llama2-70b/server.yaml) — the knob that
+    # fits the 70B tier on a v5e-8 host and feeds the bandwidth-bound
+    # decode path packed weights. The transformer dispatches on the param
+    # type (QuantizedArray), so this field only drives the loaders.
+    quantize: str = "none"
+    # Serving KV-cache quantization block: int8 k/v + per-slot-per-head f32
+    # scales. None = follow `quantize` (any quantized weight tier also
+    # quantizes the cache); True/False force.
+    quantize_kv: Optional[bool] = None
+
     # Training-time behavior. "nothing_saveable" = full remat (memory-safe
     # default); "dots_saveable" / "dots_with_no_batch_dims_saveable" save
     # matmul outputs; "save_attn_out" saves only the named per-layer

@@ -313,12 +313,22 @@ def family_of(cfg: ModelConfig) -> str:
 
 
 def convert(cfg: ModelConfig, state_dict: StateDict,
-            dtype: str = "float32") -> Dict:
-    """HF state dict -> param tree (numpy, cast to `dtype`)."""
+            dtype: str = "float32", quantize: str = "none") -> Dict:
+    """HF state dict -> param tree (numpy, cast to `dtype`).
+
+    quantize="int8"|"int4" applies blockwise weight-only quantization to
+    the attention/MLP matmuls right after conversion (ops/quantization.py),
+    walking stacked weights one layer at a time so importing a 70B-class
+    checkpoint peaks at ~one f32 layer above the packed size."""
     import jax
 
     params = CONVERTERS[family_of(cfg)](cfg, state_dict)
-    return jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+    if quantize != "none":
+        from runbooks_tpu.ops.quantization import quantize_params
+
+        params = quantize_params(params, quantize)
+    return params
 
 
 def load_torch_state_dict(model_dir: str) -> Dict[str, Array]:

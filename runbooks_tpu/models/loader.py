@@ -29,8 +29,18 @@ from runbooks_tpu.utils import contract
 
 
 def load_weights(params_cfg: dict):
+    """params.quantize ("none"|"int8"|"int4" — the reference contract's
+    `quantize:` field) imports the checkpoint straight into the packed
+    representation: HF sources quantize layer-by-layer during conversion,
+    so a 70B import never holds both a full-precision and a packed copy."""
+    from runbooks_tpu.ops.quantization import resolve_quantize_mode
+
     cfg = get_config(params_cfg.get("model", "debug"),
                      **params_cfg.get("model_overrides", {}))
+    quantize = resolve_quantize_mode(params_cfg, cfg)
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, quantize=quantize)
     source = params_cfg.get("source", "random")
     if source == "huggingface":
         hf_name = params_cfg["hf_name"]
@@ -40,16 +50,22 @@ def load_weights(params_cfg: dict):
             hf_name, allow_patterns=["*.safetensors", "*.bin", "*.json",
                                      "tokenizer*"])
         state_dict = load_torch_state_dict(local_dir)
-        weights = convert(cfg, state_dict, dtype=cfg.param_dtype)
+        weights = convert(cfg, state_dict, dtype=cfg.param_dtype,
+                          quantize=quantize)
     elif source == "dir":
         model_dir = params_cfg.get("dir", contract.model_dir())
         state_dict = load_torch_state_dict(model_dir)
-        weights = convert(cfg, state_dict, dtype=cfg.param_dtype)
+        weights = convert(cfg, state_dict, dtype=cfg.param_dtype,
+                          quantize=quantize)
     elif source == "random":
         from runbooks_tpu.models.transformer import init_params
 
         weights = init_params(cfg, jax.random.key(
             int(params_cfg.get("seed", 0))))
+        if quantize != "none":
+            from runbooks_tpu.ops.quantization import quantize_params
+
+            weights = quantize_params(weights, quantize)
     else:
         raise ValueError(f"unknown source {source!r}")
     return cfg, weights
@@ -62,14 +78,31 @@ def main() -> int:
     artifacts = params_cfg.get("artifacts_dir") or contract.artifacts_dir()
     os.makedirs(artifacts, exist_ok=True)
     mgr = CheckpointManager(artifacts, async_save=False)
-    mgr.save(0, {"params": weights}, force=True)
+    # QuantizedArray nodes save as plain dicts (orbax restores without a
+    # target); serve/api.load_model reconstructs them on restore.
+    from runbooks_tpu.ops.quantization import (
+        pack_for_checkpoint,
+        tree_weight_bytes,
+    )
+
+    mgr.save(0, {"params": pack_for_checkpoint(weights)}, force=True)
     mgr.wait()
     mgr.close()
 
-    n_params = sum(int(np.prod(np.shape(x)))
-                   for x in jax.tree.leaves(weights))
+    from runbooks_tpu.ops.quantization import QuantizedArray
+
+    def _count(x):
+        if isinstance(x, QuantizedArray):  # logical (pre-packing) count
+            return int(np.prod(x.values.shape[:-2])) * x.in_dim \
+                * x.values.shape[-1]
+        return int(np.prod(np.shape(x)))
+
+    n_params = sum(_count(x) for x in jax.tree.leaves(
+        weights, is_leaf=lambda x: isinstance(x, QuantizedArray)))
     meta = {"model": cfg.name, "num_params": n_params,
             "vocab_size": cfg.vocab_size,
+            "quantize": cfg.quantize,
+            "weight_bytes": tree_weight_bytes(weights),
             "source": params_cfg.get("source", "random")}
     with open(os.path.join(artifacts, "model.json"), "w") as f:
         json.dump(meta, f, indent=2)

@@ -33,10 +33,33 @@ from runbooks_tpu.ops.attention import (
     make_attention_mask,
 )
 from runbooks_tpu.ops.norms import layer_norm, rms_norm
+from runbooks_tpu.ops.quantization import (
+    QuantizedArray,
+    dequantize_kv,
+    quantize_kv,
+    quantized_matmul,
+)
 from runbooks_tpu.ops.rotary import apply_rope
 from runbooks_tpu.parallel.sharding import with_logical_constraint
 
 Params = Dict[str, Any]
+
+# Flash cached-prefill only pays off once the query block is at least one
+# sublane tile; below this the XLA path's mask build is noise anyway.
+FLASH_CACHED_PREFILL_MIN_Q = 16
+
+
+def _matmul(x: jax.Array, w, ad) -> jax.Array:
+    """x[..., k] @ w[k, out] in the activation dtype, f32 accumulation.
+    Weight-only-quantized layers (QuantizedArray) take the fused
+    dequant-matmul: integer blocks enter the einsum directly and the
+    per-block scales apply post-dot (ops/quantization.py), so the bf16
+    weight is never materialized — the point of weight-only quantization
+    on the bandwidth-bound decode path."""
+    if isinstance(w, QuantizedArray):
+        return quantized_matmul(x, w, compute_dtype=ad).astype(ad)
+    return jnp.einsum("...k,ko->...o", x, w.astype(ad),
+                      preferred_element_type=jnp.float32).astype(ad)
 
 
 # ---------------------------------------------------------------------------
@@ -204,23 +227,45 @@ class KVCache:
       Allocate with ``trash_slot=True`` (cache_len = max_len+1) and point
       padding at slot max_len so pad tokens land in a slot no real query
       ever attends (slot s is visible only to queries with position >= s).
+
+    quantize_kv=True stores k/v as int8 with one f32 scale per
+    (layer, row, slot, kv-head) in k_scale/v_scale
+    ([num_layers, batch, cache_len, num_kv_heads]) — halving the HBM the
+    bandwidth-bound decode step streams, which doubles max_slots x
+    max_seq_len at fixed memory. forward() detects the int8 dtype and
+    quantizes on write / dequantizes on read transparently.
     """
 
     k: jax.Array
     v: jax.Array
     index: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @classmethod
     def create(cls, cfg: ModelConfig, batch: int, max_len: int,
-               trash_slot: bool = False) -> "KVCache":
+               trash_slot: bool = False,
+               quantize_kv: bool = False) -> "KVCache":
         cache_len = max_len + 1 if trash_slot else max_len
         shape = (cfg.num_layers, batch, cache_len, cfg.num_kv_heads,
                  cfg.head_dim)
+        if quantize_kv:
+            return cls(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                index=jnp.zeros((), jnp.int32),
+                k_scale=jnp.zeros(shape[:-1], jnp.float32),
+                v_scale=jnp.zeros(shape[:-1], jnp.float32),
+            )
         return cls(
             k=jnp.zeros(shape, cfg.activation_dtype),
             v=jnp.zeros(shape, cfg.activation_dtype),
             index=jnp.zeros((), jnp.int32),
         )
+
+    @property
+    def quantized(self) -> bool:
+        return self.k.dtype == jnp.int8
 
 
 # ---------------------------------------------------------------------------
@@ -284,6 +329,28 @@ def resolve_attention_impl(cfg: ModelConfig) -> str:
     if cfg.position_type == "alibi" or cfg.logit_softcap is not None:
         impl = "xla"
     return impl
+
+
+def use_flash_cached_prefill(cfg: ModelConfig, q_len: int) -> bool:
+    """Route a prefill-with-cache through the flash kernel instead of the
+    XLA O(s*kv) path? True when the query block is at least one flash tile
+    and the kernel covers the config (ALiBi bias and logit softcap are
+    XLA-only, as in resolve_attention_impl). Decode (q_len=1) always stays
+    XLA. forward() skips the mask build entirely on this path — the kernel
+    masks from absolute positions, which for a cache (slot i == position i)
+    is exactly the XLA mask."""
+    if q_len < FLASH_CACHED_PREFILL_MIN_Q:
+        return False
+    if cfg.position_type == "alibi" or cfg.logit_softcap is not None:
+        return False
+    impl = cfg.attention_impl
+    if impl == "flash":
+        return True
+    if impl != "auto":
+        return False
+    from runbooks_tpu.ops.flash_attention import is_tpu_backend
+
+    return is_tpu_backend()
 
 
 def _dispatch_attention(cfg: ModelConfig, q, k, v, positions, segment_ids,
@@ -365,8 +432,7 @@ def _attention_block(
     ad = cfg.activation_dtype
 
     def proj(w, bname):
-        y = jnp.einsum("bsh,hd->bsd", x, w.astype(ad),
-                       preferred_element_type=jnp.float32).astype(ad)
+        y = _matmul(x, w, ad)
         if bname in p:
             y = y + p[bname].astype(ad)
         return y
@@ -387,35 +453,79 @@ def _attention_block(
 
     new_layer_cache = None
     if layer_cache is not None:
-        ck, cv, index, view = layer_cache
+        ck, cv, ck_s, cv_s, index, view = layer_cache
+        quantized = ck.dtype == jnp.int8
+        if quantized:
+            # int8 KV: one f32 scale per (row, slot, kv-head) rides next to
+            # the int8 values; both scatter with the same indices.
+            k_w, k_s = quantize_kv(k)
+            v_w, v_s = quantize_kv(v)
+        else:
+            k_w, v_w, k_s, v_s = k, v, None, None
         if index is None:
             # Position-scatter mode: row b token j -> slot positions[b, j].
             cache_len = ck.shape[1]
             slot = jnp.clip(positions, 0, cache_len - 1)
             b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
-            ck = ck.at[b_idx, slot].set(k)
-            cv = cv.at[b_idx, slot].set(v)
+            ck = ck.at[b_idx, slot].set(k_w)
+            cv = cv.at[b_idx, slot].set(v_w)
+            if quantized:
+                ck_s = ck_s.at[b_idx, slot].set(k_s)
+                cv_s = cv_s.at[b_idx, slot].set(v_s)
         else:
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, index, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, index, 0, 0))
+            ck = jax.lax.dynamic_update_slice(ck, k_w, (0, index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v_w, (0, index, 0, 0))
+            if quantized:
+                ck_s = jax.lax.dynamic_update_slice(ck_s, k_s, (0, index, 0))
+                cv_s = jax.lax.dynamic_update_slice(cv_s, v_s, (0, index, 0))
         # Writes go to the FULL cache; attention READS only [0, view).
         # Exact for any view > max query position: slot s is attended only
         # by queries at positions >= s, so slots beyond the view hold
         # nothing a masked-in query could see. Serving uses this to stop
         # decode from streaming the whole max-length cache through HBM
         # when occupancy is low (the decode step is bandwidth-bound).
-        k, v = (ck, cv) if view is None else (ck[:, :view], cv[:, :view])
-        new_layer_cache = (ck, cv)
-        # Decode/prefill-with-cache always uses the XLA path (kernels cover
-        # the training shapes; cache attention is bandwidth-bound anyway).
-        out = dot_product_attention(
-            q, k, v, mask=mask, bias=bias, logit_softcap=cfg.logit_softcap)
+        if view is None:
+            k, v = ck, cv
+            rk_s, rv_s = ck_s, cv_s
+        else:
+            k, v = ck[:, :view], cv[:, :view]
+            rk_s = ck_s[:, :view] if quantized else None
+            rv_s = cv_s[:, :view] if quantized else None
+        if quantized:
+            # Dequantize at the read: the scale multiply fuses into the
+            # attention contraction, so HBM streams int8 + one scale per
+            # row — half the bytes of the bf16 cache the decode step is
+            # bound on.
+            k = dequantize_kv(k, rk_s, ad)
+            v = dequantize_kv(v, rv_s, ad)
+        new_layer_cache = (ck, cv, ck_s, cv_s)
+        if mask is None:
+            # Flash cached-prefill (forward() skipped the O(s*kv) mask
+            # build): cache slot i holds absolute position i by
+            # construction, so the kernel's causal-by-absolute-position
+            # masking reproduces the XLA path's mask exactly — unwritten
+            # or future slots are never attended. block_skip stays off:
+            # query rows start at position cache.index, not 0, so grid
+            # index alignment does not hold.
+            from runbooks_tpu.ops.flash_attention import flash_attention
+
+            kv_pos = jnp.broadcast_to(
+                jnp.arange(k.shape[1], dtype=jnp.int32)[None, :],
+                (b, k.shape[1]))
+            out = flash_attention(
+                q, k, v, positions, kv_pos, None, None, True, None,
+                cfg.flash_block_q, cfg.flash_block_k, block_skip=False)
+        else:
+            # Decode (s=1) keeps the XLA path: a one-row query block has no
+            # O(s^2) term and the step is bandwidth-bound anyway.
+            out = dot_product_attention(
+                q, k, v, mask=mask, bias=bias,
+                logit_softcap=cfg.logit_softcap)
     else:
         out = _dispatch_attention(cfg, q, k, v, positions, segment_ids,
                                   mask, bias)
     out = out.reshape(b, s, cfg.q_dim)
-    out = jnp.einsum("bsd,dh->bsh", out, p["wo"].astype(ad),
-                     preferred_element_type=jnp.float32).astype(ad)
+    out = _matmul(out, p["wo"], ad)
     if "bo" in p:
         out = out + p["bo"].astype(ad)
     return out, new_layer_cache
@@ -425,8 +535,7 @@ def _mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
     ad = cfg.activation_dtype
 
     def mm(y, w):
-        return jnp.einsum("bsh,hd->bsd", y, w.astype(ad),
-                          preferred_element_type=jnp.float32).astype(ad)
+        return _matmul(y, w, ad)
 
     if cfg.gated_mlp:
         gate = mm(x, p["wi_gate"])
@@ -554,9 +663,15 @@ def forward(
         max_kv = cache_view if cache_view is not None else cache.k.shape[2]
         kv_positions = jnp.broadcast_to(
             jnp.arange(max_kv, dtype=jnp.int32)[None, :], (b, max_kv))
-        # Slots at arange > q position are either future or unwritten: the
-        # causal comparison masks both, so no separate validity mask needed.
-        mask = make_attention_mask(positions, kv_positions, causal=True)
+        if use_flash_cached_prefill(cfg, s):
+            # Flash cached-prefill: the kernel masks causally from absolute
+            # positions; no O(s*kv) mask tensor (see _attention_block).
+            mask = None
+        else:
+            # Slots at arange > q position are either future or unwritten:
+            # the causal comparison masks both, so no separate validity
+            # mask needed.
+            mask = make_attention_mask(positions, kv_positions, causal=True)
     else:
         kv_positions = positions
         if resolve_attention_impl(cfg) == "flash":
@@ -580,8 +695,9 @@ def forward(
     def scan_body(carry, scanned):
         x, aux_sum = carry
         if cache is not None:
-            layer, ck, cv = scanned
-            layer_cache = (ck, cv, None if scatter_mode else cache.index,
+            layer, ck, cv, ck_s, cv_s = scanned
+            layer_cache = (ck, cv, ck_s, cv_s,
+                           None if scatter_mode else cache.index,
                            cache_view)
         else:
             layer = scanned
@@ -592,10 +708,15 @@ def forward(
 
     aux_total = jnp.zeros((), jnp.float32)
     if cache is not None:
-        (x, aux_total), (new_k, new_v) = jax.lax.scan(
-            scan_body, (x, aux_total), (params["layers"], cache.k, cache.v))
+        # k_scale/v_scale are None (empty pytrees) for an unquantized
+        # cache; scan threads them through untouched either way.
+        (x, aux_total), (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            scan_body, (x, aux_total),
+            (params["layers"], cache.k, cache.v,
+             cache.k_scale, cache.v_scale))
         new_index = cache.index if scatter_mode else cache.index + s
-        new_cache = KVCache(k=new_k, v=new_v, index=new_index)
+        new_cache = KVCache(k=new_k, v=new_v, index=new_index,
+                            k_scale=new_ks, v_scale=new_vs)
     else:
         from runbooks_tpu.parallel.sharding import _current_mesh
 

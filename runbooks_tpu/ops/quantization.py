@@ -1,0 +1,342 @@
+"""Blockwise weight-only quantization (int8 / packed int4) + int8 KV helpers.
+
+The reference's flagship serves are 4-bit (reference: examples/llama2-70b/
+server.yaml `quantize: int4` on one A100; examples/falcon-40b/server.yaml
+likewise) — without quantization a 70B bf16 model (~140 GB) cannot fit a
+v5e-8 host. Decode is HBM-bandwidth-bound (serve/engine.py design note), so
+shrinking the bytes streamed per token — weights 2x/4x, KV cache 2x — buys
+decode tok/s directly in addition to fitting the big tier.
+
+Scheme (weight-only, symmetric, blockwise along the contraction axis):
+
+- A weight ``w`` of shape ``[..., in, out]`` is split into ``in/block_size``
+  blocks along ``in``; each (block, out-channel) gets one f32 scale
+  ``amax/qmax`` and stores ``round(w/scale)`` as int8 (int4: two nibbles
+  packed per byte along ``in``, so the packed array is ``[..., in/2, out]``).
+- ``quantized_matmul`` never materializes the dequantized weight at f32/bf16
+  width across the whole matmul: it einsums x-blocks against integer blocks
+  with ``preferred_element_type=float32`` and applies the scales POST-dot
+  (``sum_b scale_b * (x_b . q_b)`` — exact, and XLA fuses the int->compute
+  cast + scale multiply into the contraction instead of writing a
+  dequantized copy of the weight to memory).
+- Activations stay in the model's activation dtype; only weights (and
+  optionally the serving KV cache) are quantized. int8 KV stores one f32
+  scale per (slot, kv-head) next to int8 k/v — `quantize_kv`/`dequantize_kv`
+  are the engine-side halves (models/transformer.py applies them inside the
+  cache read/write).
+
+``QuantizedArray`` is a pytree (values/scales are leaves; bits/block_size
+are static metadata), so stacked-layer weights scan, shard, and jit exactly
+like plain arrays. ``quantize_params`` converts a model param tree in place
+(attention projections + dense MLP mats), walking stacked weights layer by
+layer so peak host RAM during a big-model load stays ~one f32 layer above
+the packed size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+QUANTIZE_MODES = ("none", "int8", "int4")
+
+# Param-tree keys eligible for weight-only quantization: the big matmuls of
+# the attention and dense-MLP blocks. Norm scales, biases, embeddings, the
+# LM head, and MoE experts (routed gather-matmuls, not plain einsums) stay
+# in the param dtype.
+QUANTIZABLE_KEYS = {
+    "attn": ("wq", "wk", "wv", "wo"),
+    "mlp": ("wi", "wi_gate", "wi_up", "wo"),
+}
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantizedArray:
+    """Blockwise-quantized weight. Logical shape ``[..., in, out]``.
+
+    values: int8 ``[..., in, out]`` (bits=8) or uint8 ``[..., in/2, out]``
+        (bits=4 — in-axis pairs (2i, 2i+1) packed low/high nibble).
+    scales: f32 ``[..., in/block_size, out]`` — one per (block, out-channel).
+    bits / block_size: static pytree metadata (jit/scan/shard-transparent).
+    """
+
+    values: jax.Array
+    scales: jax.Array
+    bits: int = dataclasses.field(metadata=dict(static=True), default=8)
+    block_size: int = dataclasses.field(metadata=dict(static=True), default=128)
+
+    @property
+    def in_dim(self) -> int:
+        mult = 2 if self.bits == 4 else 1
+        return self.values.shape[-2] * mult
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.values.shape)) * self.values.dtype.itemsize \
+            + int(np.prod(self.scales.shape)) * self.scales.dtype.itemsize
+
+
+def _qmax(bits: int) -> int:
+    # Symmetric ranges: +-127 (int8), +-7 (int4 — the -8 code is unused so
+    # negation is exact and pack/unpack stays symmetric).
+    return 127 if bits == 8 else 7
+
+
+def resolve_block_size(in_dim: int, block_size: int, bits: int) -> int:
+    """Largest usable block <= block_size that divides in_dim (int4 also
+    needs an even block so nibble pairs never straddle blocks)."""
+    bs = min(block_size, in_dim)
+    while bs > 1 and (in_dim % bs != 0 or (bits == 4 and bs % 2 != 0)):
+        bs -= 1
+    if bits == 4 and in_dim % 2 != 0:
+        raise ValueError(f"int4 needs an even contraction dim, got {in_dim}")
+    return max(bs, 1)
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """[..., in, out] int8 in [-7, 7] -> [..., in/2, out] uint8 (low nibble
+    = even in-index, high nibble = odd)."""
+    u = jnp.asarray(q, jnp.int32) & 0xF
+    lo, hi = u[..., 0::2, :], u[..., 1::2, :]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """Inverse of pack_int4: [..., in/2, out] uint8 -> [..., in, out] int8."""
+    p = jnp.asarray(packed, jnp.int32)
+    lo, hi = p & 0xF, (p >> 4) & 0xF
+    both = jnp.stack([lo, hi], axis=-2)                  # [..., in/2, 2, out]
+    flat = both.reshape(*packed.shape[:-2], -1, packed.shape[-1])
+    return jnp.where(flat > 7, flat - 16, flat).astype(jnp.int8)
+
+
+def quantize(w, bits: int = 8, block_size: int = 128) -> QuantizedArray:
+    """Blockwise symmetric quantization of ``[..., in, out]`` along ``in``."""
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
+    w = jnp.asarray(w)
+    *lead, in_dim, out = w.shape
+    bs = resolve_block_size(in_dim, block_size, bits)
+    nb = in_dim // bs
+    wb = w.astype(jnp.float32).reshape(*lead, nb, bs, out)
+    amax = jnp.max(jnp.abs(wb), axis=-2)                 # [..., nb, out]
+    scales = amax / _qmax(bits)
+    safe = jnp.where(scales == 0.0, 1.0, scales)
+    q = jnp.clip(jnp.round(wb / safe[..., None, :]), -_qmax(bits),
+                 _qmax(bits))
+    q = q.reshape(*lead, in_dim, out).astype(jnp.int8)
+    if bits == 4:
+        q = pack_int4(q)
+    return QuantizedArray(values=q, scales=scales.astype(jnp.float32),
+                          bits=bits, block_size=bs)
+
+
+def dequantize(qa: QuantizedArray, dtype=jnp.float32) -> jax.Array:
+    """Materialize the full weight (tests / reference path; the serving
+    matmul never calls this — see quantized_matmul)."""
+    q = unpack_int4(qa.values) if qa.bits == 4 else qa.values
+    *lead, in_dim, out = q.shape
+    nb = in_dim // qa.block_size
+    wb = q.astype(jnp.float32).reshape(*lead, nb, qa.block_size, out)
+    w = wb * qa.scales[..., None, :]
+    return w.reshape(*lead, in_dim, out).astype(dtype)
+
+
+def quantized_matmul(x: jax.Array, qa: QuantizedArray,
+                     compute_dtype=jnp.bfloat16) -> jax.Array:
+    """``x[..., in] @ w[in, out]`` with blockwise dequantization fused into
+    the contraction: integer blocks enter the einsum in compute_dtype with
+    f32 accumulation; scales multiply the per-block partial sums POST-dot
+    (sum_b s_b * (x_b . q_b) == x @ dequantize(w), exactly). Returns f32."""
+    if qa.values.ndim != 2:
+        raise ValueError(
+            "quantized_matmul wants a per-layer [in, out] weight; got "
+            f"{qa.values.shape} (scan over stacked layers first)")
+    q = unpack_int4(qa.values) if qa.bits == 4 else qa.values
+    in_dim, out = q.shape
+    bs = qa.block_size
+    nb = in_dim // bs
+    xb = x.astype(compute_dtype).reshape(*x.shape[:-1], nb, bs)
+    wb = q.astype(compute_dtype).reshape(nb, bs, out)
+    partial = jnp.einsum("...nk,nko->...no", xb, wb,
+                         preferred_element_type=jnp.float32)
+    return jnp.sum(partial * qa.scales, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# Model param trees
+# ---------------------------------------------------------------------------
+
+def resolve_quantize_mode(params_cfg: Dict[str, Any], cfg=None) -> str:
+    """One resolution rule for the `quantize` contract param, shared by the
+    loader workload and the serving entrypoint (they must accept the same
+    spellings or a checkpoint the loader wrote could be refused at serve
+    time): params value wins, else the ModelConfig field, else "none";
+    anything outside QUANTIZE_MODES raises."""
+    default = getattr(cfg, "quantize", "none") if cfg is not None else "none"
+    mode = str(params_cfg.get("quantize", default) or "none")
+    if mode not in QUANTIZE_MODES:
+        raise ValueError(
+            f"unknown quantize mode {mode!r}; expected one of "
+            f"{'|'.join(QUANTIZE_MODES)}")
+    return mode
+
+
+def tree_quantize_mode(params) -> str:
+    """The mode a param tree is actually quantized at ("none" when no
+    QuantizedArray leaves): lets loaders detect an already-packed
+    checkpoint and callers spot a request/checkpoint mismatch."""
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedArray)):
+        if isinstance(leaf, QuantizedArray):
+            return "int8" if leaf.bits == 8 else "int4"
+    return "none"
+
+
+def quantize_params(params: Dict[str, Any], mode: str,
+                    block_size: int = 128) -> Dict[str, Any]:
+    """Quantize a transformer param tree's big matmuls in place (returns the
+    same tree object). Stacked ``[L, in, out]`` weights are processed one
+    layer slice at a time and the f32 original dropped immediately, so a
+    70B-class load peaks at ~one f32 layer above the packed size instead of
+    2x the full model."""
+    if mode not in QUANTIZE_MODES:
+        raise ValueError(
+            f"unknown quantize mode {mode!r}; expected one of "
+            f"{'|'.join(QUANTIZE_MODES)}")
+    if mode == "none":
+        return params
+    bits = 8 if mode == "int8" else 4
+    layers = params.get("layers", {})
+    for group, keys in QUANTIZABLE_KEYS.items():
+        sub = layers.get(group)
+        if not isinstance(sub, dict):
+            continue
+        for key in keys:
+            w = sub.get(key)
+            if w is None or isinstance(w, QuantizedArray):
+                continue
+            sub[key] = _quantize_stacked(w, bits, block_size)
+    return params
+
+
+def _quantize_stacked(w, bits: int, block_size: int) -> QuantizedArray:
+    """Quantize ``[L, in, out]`` (or ``[in, out]``) one leading slice at a
+    time, bounding the transient f32 footprint to one layer."""
+    w = np.asarray(w) if not isinstance(w, jax.Array) else w
+    if w.ndim == 2:
+        return quantize(w, bits, block_size)
+    if w.ndim != 3:
+        raise ValueError(f"expected [L, in, out] or [in, out], got {w.shape}")
+    vals, scs = [], []
+    bs = resolve_block_size(w.shape[-2], block_size, bits)
+    for l in range(w.shape[0]):
+        qa = quantize(w[l], bits, bs)
+        vals.append(np.asarray(qa.values))
+        scs.append(np.asarray(qa.scales))
+    return QuantizedArray(values=jnp.asarray(np.stack(vals)),
+                          scales=jnp.asarray(np.stack(scs)),
+                          bits=bits, block_size=bs)
+
+
+def quantized_logical_axes(params: Dict[str, Any],
+                           axes: Dict[str, Any]) -> Dict[str, Any]:
+    """Rewrite a ``param_logical_axes`` tree so positions holding a
+    QuantizedArray get a matching QuantizedArray-of-axis-tuples node (values
+    keep the weight's axes — the packed in-dim shards like the original, or
+    degrades to replicated via the divisibility check; the block dim of the
+    scales is replicated)."""
+    def is_leaf(x):
+        return isinstance(x, QuantizedArray) or (
+            isinstance(x, tuple)
+            and all(isinstance(e, (str, type(None))) for e in x))
+
+    def fix(p, a):
+        if isinstance(p, QuantizedArray):
+            scale_axes = tuple(a[:-2]) + (None, a[-1])
+            return QuantizedArray(values=a, scales=scale_axes,
+                                  bits=p.bits, block_size=p.block_size)
+        return a
+
+    return jax.tree.map(fix, params, axes, is_leaf=is_leaf)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint round-trip (orbax restores plain dict/array trees; the static
+# metadata rides along as an array leaf)
+# ---------------------------------------------------------------------------
+
+_QMARK = "__quantized__"
+
+
+def pack_for_checkpoint(tree):
+    """QuantizedArray nodes -> plain dicts an orbax restore-without-target
+    reproduces faithfully."""
+    def pack(x):
+        if isinstance(x, QuantizedArray):
+            return {_QMARK: {
+                "values": x.values, "scales": x.scales,
+                "meta": np.asarray([x.bits, x.block_size], np.int32)}}
+        return x
+
+    return jax.tree.map(pack, tree,
+                        is_leaf=lambda x: isinstance(x, QuantizedArray))
+
+
+def unpack_from_checkpoint(tree):
+    """Inverse of pack_for_checkpoint (no-op on unquantized trees)."""
+    def is_marker(x):
+        return isinstance(x, dict) and set(x) == {_QMARK}
+
+    def unpack(x):
+        if is_marker(x):
+            inner = x[_QMARK]
+            bits, bs = (int(v) for v in np.asarray(inner["meta"]))
+            return QuantizedArray(values=inner["values"],
+                                  scales=inner["scales"],
+                                  bits=bits, block_size=bs)
+        return x
+
+    return jax.tree.map(unpack, tree, is_leaf=is_marker)
+
+
+def tree_weight_bytes(params) -> int:
+    """Total parameter bytes (QuantizedArray counts packed values+scales) —
+    the number the serving memory math cares about."""
+    total = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedArray)):
+        if isinstance(leaf, QuantizedArray):
+            total += leaf.nbytes
+        else:
+            total += int(np.prod(np.shape(leaf))) * \
+                jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache
+# ---------------------------------------------------------------------------
+
+def quantize_kv(x: jax.Array):
+    """[..., head_dim] activations -> (int8 values, f32 scales[...]) with
+    one symmetric scale per (token, head) row — the serving KV-cache write
+    half (per-slot-per-head scales; models/transformer.py)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Cache-read half: int8 [..., d] * f32 scale[...] -> dtype. The
+    multiply fuses into the attention contraction that consumes it, so HBM
+    streams int8 + one scale per row instead of bf16/f32 k/v."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)

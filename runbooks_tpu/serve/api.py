@@ -53,11 +53,32 @@ def _eos_id(tok) -> Optional[int]:
 def load_model(params: dict) -> Tuple[ModelConfig, Any]:
     """Model from params.json: named config + optional orbax checkpoint under
     the model mount (falls back to random init for smoke serving, mirroring
-    the reference's opt-125m kind-cluster smoke test)."""
+    the reference's opt-125m kind-cluster smoke test).
+
+    params.quantize ("none"|"int8"|"int4", the reference Server contract's
+    `quantize:` field) selects weight-only quantization: checkpoints saved
+    pre-quantized by the loader restore packed directly; anything else is
+    quantized here layer-by-layer before serving, so host RAM peaks ~one
+    f32 layer above the packed size instead of holding bf16 and packed
+    copies of a 70B model at once."""
+    import dataclasses as _dc
+
     import jax
+
+    from runbooks_tpu.ops.quantization import (
+        quantize_params,
+        resolve_quantize_mode,
+        tree_quantize_mode,
+        unpack_from_checkpoint,
+    )
 
     cfg = get_config(params.get("model", "debug"),
                      **params.get("model_overrides", {}))
+    quantize = resolve_quantize_mode(params, cfg)
+    overrides = {"quantize": quantize}
+    if params.get("quantize_kv") is not None:
+        overrides["quantize_kv"] = bool(params["quantize_kv"])
+    cfg = _dc.replace(cfg, **overrides)
     ckpt_dir = params.get("checkpoint") or contract.model_dir()
     import os
 
@@ -78,6 +99,10 @@ def load_model(params: dict) -> Tuple[ModelConfig, Any]:
                 full = mgr.restore(None)
                 model_params = (full["params"] if isinstance(full, dict)
                                 else full.params)
+                # Loader-quantized checkpoints store QuantizedArrays as
+                # plain dict nodes (orbax restores without a target);
+                # reconstruct them before use. No-op otherwise.
+                model_params = unpack_from_checkpoint(model_params)
         finally:
             mgr.close()
     if model_params is None:
@@ -91,6 +116,20 @@ def load_model(params: dict) -> Tuple[ModelConfig, Any]:
                 "no params")
         model_params = jax.jit(lambda r: init_params(cfg, r))(
             jax.random.key(params.get("seed", 0)))
+    stored = tree_quantize_mode(model_params)
+    if stored == "none" and quantize != "none":
+        model_params = quantize_params(model_params, quantize)
+    elif stored != quantize:
+        # An already-packed checkpoint cannot be re-quantized to a
+        # different tier (int4 -> int8 has no information to recover);
+        # serve what is stored, but say so loudly instead of silently
+        # serving a different precision than configured.
+        print(f"serve: checkpoint is quantized {stored} but params "
+              f"requested quantize={quantize}; serving the stored "
+              f"{stored} weights", flush=True)
+        import dataclasses as _dc2
+
+        cfg = _dc2.replace(cfg, quantize=stored)
     return cfg, model_params
 
 
@@ -98,8 +137,15 @@ class EngineWorker:
     """Single thread that owns the engine: admits requests, steps the decode
     loop, resolves futures of finished requests."""
 
-    def __init__(self, engine: InferenceEngine):
+    def __init__(self, engine: InferenceEngine,
+                 warn_cold_prefix: bool = False):
         self.engine = engine
+        # One-time operator warning when a runtime /v1/prefix registration
+        # is about to compile the prefix-KV builder on THIS thread (which
+        # stalls every in-flight decode for the compile, ~27 s cold on the
+        # v5e relay). Servers started with warmup+warm_prefix pre-compile
+        # the builder per bucket and never hit it.
+        self._warn_cold_prefix = warn_cold_prefix
         self._pending: list[Tuple[Request, Future]] = []
         self._inflight: list[Tuple[Request, Future]] = []
         self._prefix_jobs: list[Tuple[list, Future]] = []
@@ -154,6 +200,15 @@ class EngineWorker:
                         # in-flight stream). Shapes queue and warm one per
                         # loop iteration, interleaved with decode steps.
                         fresh = not self.engine.has_prefix(tokens)
+                        if fresh and self._warn_cold_prefix:
+                            self._warn_cold_prefix = False
+                            print(
+                                "serve: runtime /v1/prefix registration "
+                                "compiles the prefix-KV builder on the "
+                                "engine worker thread — in-flight decodes "
+                                "stall until it finishes. Start the server "
+                                "with warm_prefix: true (with warmup) to "
+                                "pre-compile it per bucket.", flush=True)
                         plen = self.engine.register_prefix(tokens,
                                                            warmup=False)
                         if plen and fresh:
@@ -284,7 +339,8 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         # compile on the serving thread (cost: len(buckets) extra startup
         # compiles).
         engine.warmup(prefix_build=warm_prefix)
-    worker = EngineWorker(engine)
+    worker = EngineWorker(engine,
+                          warn_cold_prefix=not (warmup and warm_prefix))
     app = web.Application()
     app["worker"] = worker
     app["tokenizer"] = tokenizer

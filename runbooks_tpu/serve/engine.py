@@ -26,6 +26,13 @@ inference is in-framework and TPU-shaped:
   trash slot for padding (see models/transformer.KVCache).
 - Sampling is jitted with per-slot temperature/top_k/top_p so mixed request
   parameters batch together.
+- Quantized fast path: params may be weight-only int8/int4
+  (ops/quantization.py QuantizedArray — the transformer dispatches on the
+  type), and quantize_kv=True stores the slot pool as int8 with
+  per-slot-per-head scales. Decode is HBM-bandwidth-bound (see the view
+  buckets below), so fewer bytes streamed per token is directly more
+  tok/s — and the int4 tier is what fits 70B-class models on one v5e-8
+  host (docs/quantized-serving.md).
 """
 
 from __future__ import annotations
@@ -89,7 +96,8 @@ class InferenceEngine:
                  seed: int = 0, mesh=None,
                  prefill_budget: Optional[int] = None,
                  decode_chunk: Optional[int] = None,
-                 prefix_cache_size: Optional[int] = None):
+                 prefix_cache_size: Optional[int] = None,
+                 quantize_kv: Optional[bool] = None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
@@ -112,7 +120,19 @@ class InferenceEngine:
         host↔device sync (the dominant per-token cost at small batch on
         TPU) at the price of admission latency ≤ chunk-1 extra steps and
         streaming granularity of ≤ chunk tokens. Default: 8 on TPU, 1
-        elsewhere (CPU dispatch is cheap and tests want step-at-a-time)."""
+        elsewhere (CPU dispatch is cheap and tests want step-at-a-time).
+
+        quantize_kv: store the slot-pool KV cache as int8 with per-slot-
+        per-head f32 scales (models/transformer.KVCache). The decode step
+        is HBM-bandwidth-bound, so halving the cache bytes it streams buys
+        tok/s directly and doubles max_slots x max_seq_len at fixed memory.
+        Prefill still computes attention in the activation dtype (the
+        scratch rows are unquantized); rows are quantized once at the
+        splice into the pool, and decode reads dequantize in-register.
+        Pairs with weight-only quantized params (ops/quantization.py) for
+        the reference's 4-bit serving tier. None = follow the config: any
+        quantized-weight tier (cfg.quantize != "none") also quantizes the
+        cache unless cfg.quantize_kv forces otherwise."""
         self.cfg = cfg
         self.mesh = mesh
         self.prefill_budget = prefill_budget
@@ -125,10 +145,15 @@ class InferenceEngine:
             raise ValueError(
                 "pipeline (stage) parallelism is a training-path feature; "
                 "serve with tensor/data parallelism instead (mesh_tensor)")
+        if quantize_kv is None:
+            quantize_kv = (cfg.quantize_kv if cfg.quantize_kv is not None
+                           else cfg.quantize != "none")
+        self.quantize_kv = bool(quantize_kv)
         if mesh is not None:
             import contextlib
 
             from runbooks_tpu.models.transformer import param_logical_axes
+            from runbooks_tpu.ops.quantization import quantized_logical_axes
             from runbooks_tpu.parallel.sharding import (
                 spec_for_array,
                 tree_shardings,
@@ -138,11 +163,14 @@ class InferenceEngine:
             params = jax.device_put(
                 params,
                 tree_shardings(jax.eval_shape(lambda: params),
-                               param_logical_axes(cfg), mesh))
+                               quantized_logical_axes(
+                                   params, param_logical_axes(cfg)), mesh))
 
             def cache_sharding(shape):
-                spec = spec_for_array(
-                    shape, (None, "batch", None, "act_heads", None), mesh)
+                # k/v are 5-d [L, batch, slot, kv_heads, d]; the int8
+                # cache's scale arrays are 4-d [L, batch, slot, kv_heads].
+                logical = (None, "batch", None, "act_heads", None)[:len(shape)]
+                spec = spec_for_array(shape, logical, mesh)
                 return NamedSharding(mesh, spec)
 
             self._cache_sharding = cache_sharding
@@ -155,15 +183,7 @@ class InferenceEngine:
         self.params = params
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
-        self.cache = KVCache.create(cfg, max_slots, self.max_seq_len,
-                                    trash_slot=True)
-        if self._cache_sharding is not None:
-            self.cache = KVCache(
-                k=jax.device_put(self.cache.k,
-                                 self._cache_sharding(self.cache.k.shape)),
-                v=jax.device_put(self.cache.v,
-                                 self._cache_sharding(self.cache.v.shape)),
-                index=self.cache.index)
+        self.cache = self._new_pool_cache()
         self._pad_slot = self.max_seq_len  # trash slot index
         if self.prefill_budget is None:
             self.prefill_budget = self.max_seq_len
@@ -198,7 +218,7 @@ class InferenceEngine:
 
         cache_len = self.max_seq_len + 1
 
-        def prefill_fn(params, cache_k, cache_v, tokens, positions, slots,
+        def prefill_fn(params, pool, tokens, positions, slots,
                        last_pos, rng, temps, top_ks, top_ps,
                        pk=None, pv=None):
             # Prefill `rows` requests into fresh zero rows at once, then
@@ -219,6 +239,9 @@ class InferenceEngine:
             rows = tokens.shape[0]
             row_shape = (cfg.num_layers, rows, cache_len, cfg.num_kv_heads,
                          cfg.head_dim)
+            # Scratch rows stay in the activation dtype even when the pool
+            # is int8: prefill attention then runs at full precision, and
+            # each row is quantized exactly once at the splice below.
             k1 = jnp.zeros(row_shape, cfg.activation_dtype)
             v1 = jnp.zeros(row_shape, cfg.activation_dtype)
             if pk is not None:
@@ -235,26 +258,42 @@ class InferenceEngine:
             cache1 = KVCache(k=k1, v=v1, index=jnp.zeros((), jnp.int32))
             logits, cache1 = forward(cfg, params, tokens,
                                      positions=positions, cache=cache1)
-            new_k, new_v = cache_k, cache_v
+            if pool.k.dtype == jnp.int8:
+                from runbooks_tpu.ops.quantization import quantize_kv
+
+                rows_k, rows_ks = quantize_kv(cache1.k)
+                rows_v, rows_vs = quantize_kv(cache1.v)
+            else:
+                rows_k, rows_v, rows_ks, rows_vs = (cache1.k, cache1.v,
+                                                    None, None)
+            new_k, new_v = pool.k, pool.v
+            new_ks, new_vs = pool.k_scale, pool.v_scale
             for r in range(rows - 1, -1, -1):
                 new_k = jax.lax.dynamic_update_slice_in_dim(
-                    new_k, cache1.k[:, r:r + 1], slots[r], axis=1)
+                    new_k, rows_k[:, r:r + 1], slots[r], axis=1)
                 new_v = jax.lax.dynamic_update_slice_in_dim(
-                    new_v, cache1.v[:, r:r + 1], slots[r], axis=1)
+                    new_v, rows_v[:, r:r + 1], slots[r], axis=1)
+                if rows_ks is not None:
+                    new_ks = jax.lax.dynamic_update_slice_in_dim(
+                        new_ks, rows_ks[:, r:r + 1], slots[r], axis=1)
+                    new_vs = jax.lax.dynamic_update_slice_in_dim(
+                        new_vs, rows_vs[:, r:r + 1], slots[r], axis=1)
             rng, sub = jax.random.split(rng)
             last_logits = jnp.take_along_axis(
                 logits, last_pos[:, None, None], axis=1)[:, 0]
             first = sample(last_logits, sub, temps, top_ks, top_ps)
-            return first, new_k, new_v, rng
+            new_pool = KVCache(k=new_k, v=new_v, index=pool.index,
+                               k_scale=new_ks, v_scale=new_vs)
+            return first, new_pool, rng
 
-        self._prefill = jax.jit(prefill_fn, donate_argnums=(1, 2))
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
         # Same body with the prefix splice live (jit specializes per
         # (plen, suffix-bucket, rows) shape; registrations are rare and
         # suffix buckets are the same bounded set as prefill buckets).
         self._prefill_prefix = jax.jit(
-            lambda params, ck, cv, pk, pv, *rest: prefill_fn(
-                params, ck, cv, *rest, pk=pk, pv=pv),
-            donate_argnums=(1, 2))
+            lambda params, pool, pk, pv, *rest: prefill_fn(
+                params, pool, *rest, pk=pk, pv=pv),
+            donate_argnums=(1,))
 
         def prefix_build_fn(params, tokens, positions):
             # Returns the full bucket-width row; the caller slices to the
@@ -328,6 +367,22 @@ class InferenceEngine:
 
         self._decode_for = decode_for
 
+    def _new_pool_cache(self) -> KVCache:
+        """Fresh slot-pool cache (int8 + scales when quantize_kv), sharded
+        under the serving mesh when one is configured."""
+        cache = KVCache.create(self.cfg, self.max_slots, self.max_seq_len,
+                               trash_slot=True, quantize_kv=self.quantize_kv)
+        if self._cache_sharding is not None:
+            def put(a):
+                return (None if a is None
+                        else jax.device_put(a, self._cache_sharding(a.shape)))
+
+            cache = KVCache(k=put(cache.k), v=put(cache.v),
+                            index=cache.index,
+                            k_scale=put(cache.k_scale),
+                            v_scale=put(cache.v_scale))
+        return cache
+
     def _view_for(self, max_pos: int) -> int:
         """Smallest view bucket covering every query position this chunk
         can reach (caller passes max active length + chunk)."""
@@ -364,14 +419,12 @@ class InferenceEngine:
                 positions = np.full((r, bucket), self._pad_slot, np.int32)
                 positions[:, :2] = [0, 1]
                 with self._mesh_ctx():
-                    _, new_k, new_v, _ = self._prefill(
-                        self.params, self.cache.k, self.cache.v,
+                    _, self.cache, _ = self._prefill(
+                        self.params, self.cache,
                         jnp.asarray(padded), jnp.asarray(positions),
                         jnp.zeros(r, jnp.int32), jnp.ones(r, jnp.int32),
                         jax.random.key(0), jnp.zeros(r, jnp.float32),
                         jnp.zeros(r, jnp.int32), jnp.ones(r, jnp.float32))
-                self.cache = KVCache(k=new_k, v=new_v,
-                                     index=self.cache.index)
         zeros = np.zeros(self.max_slots, np.int32)
         for view in self.view_buckets:
             with self._mesh_ctx():
@@ -481,9 +534,18 @@ class InferenceEngine:
             self._prefix_cache_hit(key)
             return 0
         # Eager slices materialize fresh buffers, so later donation of
-        # the pool cache cannot invalidate the cached prefix.
+        # the pool cache cannot invalidate the cached prefix. An int8 pool
+        # dequantizes here: the prefix cache stays in the activation dtype
+        # (the splice-prefill quantizes it back on admission), so the
+        # prefix path is dtype-agnostic.
         pk = self.cache.k[:, slot, :plen]
         pv = self.cache.v[:, slot, :plen]
+        if self.cache.quantized:
+            from runbooks_tpu.ops.quantization import dequantize_kv
+
+            ad = self.cfg.activation_dtype
+            pk = dequantize_kv(pk, self.cache.k_scale[:, slot, :plen], ad)
+            pv = dequantize_kv(pv, self.cache.v_scale[:, slot, :plen], ad)
         self._prefix_cache_put(key, (pk, pv))
         return plen
 
@@ -510,8 +572,8 @@ class InferenceEngine:
         serving worker can interleave compiles with decode steps instead
         of freezing every stream for the whole sweep.
 
-        Returns the (k, v) buffers that came back from the donated call —
-        pass them to the next warm call so the sweep holds ONE extra
+        Returns the throwaway pool cache that came back from the donated
+        call — pass it to the next warm call so the sweep holds ONE extra
         pool-sized allocation total, not one per shape (a pool sized to
         fill HBM would otherwise OOM on the first registration under
         load). Drop the returned buffers when done."""
@@ -523,24 +585,15 @@ class InferenceEngine:
         positions = np.full((rows, bucket), self._pad_slot, np.int32)
         positions[:, 0] = plen
         if buffers is None:
-            dummy = KVCache.create(self.cfg, self.max_slots,
-                                   self.max_seq_len, trash_slot=True)
-            if self._cache_sharding is not None:
-                dummy = KVCache(
-                    k=jax.device_put(dummy.k,
-                                     self._cache_sharding(dummy.k.shape)),
-                    v=jax.device_put(dummy.v,
-                                     self._cache_sharding(dummy.v.shape)),
-                    index=dummy.index)
-            buffers = (dummy.k, dummy.v)
+            buffers = self._new_pool_cache()
         with self._mesh_ctx():
-            _, new_k, new_v, _ = self._prefill_prefix(
-                self.params, buffers[0], buffers[1], pk, pv,
+            _, buffers, _ = self._prefill_prefix(
+                self.params, buffers, pk, pv,
                 jnp.asarray(toks), jnp.asarray(positions),
                 jnp.zeros(rows, jnp.int32), jnp.zeros(rows, jnp.int32),
                 jax.random.key(0), jnp.zeros(rows, jnp.float32),
                 jnp.zeros(rows, jnp.int32), jnp.ones(rows, jnp.float32))
-        return (new_k, new_v)
+        return buffers
 
     def _find_prefix(self, prompt: List[int]):
         """Longest registered prefix this prompt starts with, leaving at
@@ -568,15 +621,7 @@ class InferenceEngine:
     def reset(self) -> None:
         """Recover from a failed jitted step: donated cache buffers may be
         invalid, so reallocate, and clear all slot state."""
-        self.cache = KVCache.create(self.cfg, self.max_slots,
-                                    self.max_seq_len, trash_slot=True)
-        if self._cache_sharding is not None:
-            self.cache = KVCache(
-                k=jax.device_put(self.cache.k,
-                                 self._cache_sharding(self.cache.k.shape)),
-                v=jax.device_put(self.cache.v,
-                                 self._cache_sharding(self.cache.v.shape)),
-                index=self.cache.index)
+        self.cache = self._new_pool_cache()
         self.lengths[:] = 0
         self.active[:] = False
         self.last_token[:] = 0
@@ -677,13 +722,12 @@ class InferenceEngine:
                 # serving live traffic must not be the one evicted.
                 pk, pv = self._prefix_cache[pkey]
                 self._prefix_cache_hit(pkey)
-                first, new_k, new_v, self.rng = self._prefill_prefix(
-                    self.params, self.cache.k, self.cache.v, pk, pv, *args)
+                first, self.cache, self.rng = self._prefill_prefix(
+                    self.params, self.cache, pk, pv, *args)
                 self.prefix_tokens_reused += plen * n
             else:
-                first, new_k, new_v, self.rng = self._prefill(
-                    self.params, self.cache.k, self.cache.v, *args)
-        self.cache = KVCache(k=new_k, v=new_v, index=self.cache.index)
+                first, self.cache, self.rng = self._prefill(
+                    self.params, self.cache, *args)
         first = np.asarray(first)
         for i, (slot, req) in enumerate(group):
             tok = int(first[i])

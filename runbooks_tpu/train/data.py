@@ -46,18 +46,33 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
 
-def load_tokenizer(name_or_path: Optional[str] = None):
-    """HF tokenizer when available (local files only — zero-egress images),
-    else the byte tokenizer."""
-    if name_or_path:
-        try:
-            from transformers import AutoTokenizer
+def load_tokenizer(name_or_path: Optional[str] = None,
+                   allow_byte_fallback: bool = False):
+    """Tokenizer for training/serving. No path -> the hermetic byte
+    tokenizer (the zero-download default). A PATH that fails to load
+    RAISES: silently swapping a requested HF vocab for the 258-symbol byte
+    fallback changes the token space under the model — a trainer would
+    quietly produce garbage and a server would decode gibberish behind a
+    healthy readiness probe. Pass allow_byte_fallback=True to opt back
+    into the old degrade-silently behavior (smoke setups only)."""
+    if not name_or_path:
+        return ByteTokenizer()
+    try:
+        from transformers import AutoTokenizer
 
-            return AutoTokenizer.from_pretrained(
-                name_or_path, local_files_only=True)
-        except Exception:
-            pass
-    return ByteTokenizer()
+        return AutoTokenizer.from_pretrained(
+            name_or_path, local_files_only=True)
+    except Exception as exc:
+        if allow_byte_fallback:
+            print(f"data: tokenizer {name_or_path!r} failed to load "
+                  f"({exc!r}); falling back to the byte tokenizer",
+                  flush=True)
+            return ByteTokenizer()
+        raise RuntimeError(
+            f"tokenizer {name_or_path!r} could not be loaded (is the path "
+            "mounted and complete? local_files_only=True — no hub "
+            "downloads). Pass allow_byte_fallback=True to serve the byte "
+            f"tokenizer instead: {exc}") from exc
 
 
 def read_documents(path: str, text_key: str = "text",

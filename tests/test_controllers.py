@@ -515,3 +515,25 @@ def test_model_single_pod_failure_stays_terminal(harness):
     cur = Model(get(client, "Model", "cheap"))
     c = ko.get_condition(cur.obj, cond.COMPLETE)
     assert c["reason"] == cond.REASON_JOB_FAILED
+
+
+def test_server_invalid_quantize_param_surfaces_condition(harness):
+    """A typo'd spec.params.quantize must become a visible condition, not a
+    crash-looping serve container behind a never-ready Deployment."""
+    client, cloud, sci, mgr = harness
+    client.create(Server.new("qs", spec={
+        "image": "img", "model": {"name": "qm"},
+        "params": {"model": "llama2-70b", "quantize": "int3"}}).obj)
+    mgr.reconcile_until_stable()
+    cur = Server(get(client, "Server", "qs"))
+    c = ko.get_condition(cur.obj, cond.SERVING)
+    assert c["status"] == "False"
+    assert c["reason"] == cond.REASON_INVALID_PARAMS
+    assert "int3" in c["message"]
+    # Fixing the spec clears the gate (proceeds to the model gate).
+    cur.obj["spec"]["params"]["quantize"] = "int4"
+    client.update(cur.obj)
+    mgr.reconcile_until_stable()
+    c = ko.get_condition(Server(get(client, "Server", "qs")).obj,
+                         cond.SERVING)
+    assert c["reason"] != cond.REASON_INVALID_PARAMS

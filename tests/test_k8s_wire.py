@@ -120,19 +120,25 @@ def test_list_with_label_selector(client, server):
 
 def test_watch_streams_events(client, server):
     sub = client.watch(API_VERSION, "Model", namespace="default")
-    time.sleep(0.3)  # let the stream connect
-    client.create(model("w1"))
-    event = sub.poll(timeout=5.0)
-    assert event is not None
-    etype, obj = event
-    assert etype == "ADDED"
-    assert obj["metadata"]["name"] == "w1"
-
-    client.delete(API_VERSION, "Model", "default", "w1")
-    for _ in range(10):
+    try:
+        time.sleep(0.3)  # let the stream connect
+        client.create(model("w1"))
         event = sub.poll(timeout=5.0)
         assert event is not None
-        if event[0] == "DELETED":
-            break
-    else:
-        raise AssertionError("no DELETED event")
+        etype, obj = event
+        assert etype == "ADDED"
+        assert obj["metadata"]["name"] == "w1"
+
+        client.delete(API_VERSION, "Model", "default", "w1")
+        for _ in range(10):
+            event = sub.poll(timeout=5.0)
+            assert event is not None
+            if event[0] == "DELETED":
+                break
+        else:
+            raise AssertionError("no DELETED event")
+    finally:
+        # Without close(join=True) the reader thread outlives the fixture's
+        # apiserver and prints `watch Model: reconnecting…` every 30 s for
+        # the rest of the pytest run (VERDICT r5, Weak-5).
+        sub.close(join=True)

@@ -135,3 +135,59 @@ def test_done_false_requeues_through_a_floor():
     key = ("Model", "default", "m1")
     assert key in pending
     assert pending[key] - t0 >= 0.4, "immediate requeue has no floor"
+
+
+class _BoomClient:
+    """ApiClient stub whose watch() raises a deterministic non-connectivity
+    error (a stand-in for a programming bug in the loop's own plumbing)."""
+
+    def __init__(self, exc_factory):
+        self._exc_factory = exc_factory
+
+    def watch(self, *a, **k):
+        raise self._exc_factory()
+
+    def get(self, *a, **k):
+        return None
+
+    def list(self, *a, **k):
+        return []
+
+
+def test_watch_loop_crashes_after_repeated_identical_bug():
+    """ADVICE r5: the blanket retry must not hide deterministic bugs —
+    after N consecutive identical non-connectivity failures the loop
+    re-raises so the process restarts visibly."""
+    mgr = Manager(Ctx(client=_BoomClient(lambda: RuntimeError("bug!")),
+                      cloud=None, sci=None), [Recorder()])
+    with pytest.raises(RuntimeError, match="bug!"):
+        mgr.run(threading.Event(), resync_seconds=3600.0,
+                max_backoff=0.02, crash_after=3)
+
+
+@pytest.mark.parametrize("exc_factory", [
+    lambda: ConnectionRefusedError("refused"),
+    # The wire client's typed non-404/409 HTTP error: a sustained apiserver
+    # 503 (rolling restart) repeats identically and must retry forever, not
+    # trip the crash-after-N-identical-bugs heuristic.
+    lambda: __import__("runbooks_tpu.k8s.fake", fromlist=["ApiServerError"])
+    .ApiServerError("GET /apis -> 503: apiserver is shutting down",
+                    code=503),
+], ids=["refused", "apiserver-503"])
+def test_watch_loop_retries_connectivity_errors_forever(exc_factory):
+    """Connectivity-shaped errors keep the retry-with-backoff behavior —
+    the loop must NOT crash."""
+    mgr = Manager(
+        Ctx(client=_BoomClient(exc_factory),
+            cloud=None, sci=None), [Recorder()])
+    stop = threading.Event()
+    t = threading.Thread(target=mgr.run, args=(stop,),
+                         kwargs={"resync_seconds": 3600.0,
+                                 "max_backoff": 0.02},
+                         daemon=True)
+    t.start()
+    time.sleep(0.5)
+    assert t.is_alive(), "manager crashed on a connectivity error"
+    stop.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
