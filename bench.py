@@ -65,6 +65,13 @@ def inner() -> None:
     # staged path from 0.442 toward the ~0.6 estimated ceiling.
     if os.environ.get("RBT_BENCH_PARAM_DTYPE"):
         overrides["param_dtype"] = os.environ["RBT_BENCH_PARAM_DTYPE"]
+    # Training fast-path axes (docs/training-performance.md):
+    # RBT_BENCH_ACCUM=k scans k microbatches per optimizer step (peak
+    # activation memory of one microbatch — run a k-times larger global
+    # batch than fits the plain path); RBT_BENCH_CE_CHUNK=c uses the
+    # chunked fused CE (no [b, s, vocab] f32 logits tensor).
+    accum = int(os.environ.get("RBT_BENCH_ACCUM", "1"))
+    ce_chunk = int(os.environ.get("RBT_BENCH_CE_CHUNK", "0"))
 
     cfg = get_config(model, **overrides)
     mesh = single_device_mesh()
@@ -72,7 +79,8 @@ def inner() -> None:
         total_steps=10_000, warmup_steps=10,
         mu_dtype=os.environ.get("RBT_BENCH_MU_DTYPE") or None))
     state, shardings = create_train_state(cfg, opt, mesh, jax.random.key(0))
-    step = make_train_step(cfg, opt, mesh, shardings)
+    step = make_train_step(cfg, opt, mesh, shardings,
+                           accumulate_steps=accum, loss_chunk=ce_chunk)
 
     tokens = jax.random.randint(jax.random.key(1), (batch_size, seq + 1), 0,
                                 cfg.vocab_size)
@@ -89,7 +97,14 @@ def inner() -> None:
     # backend, so use it unconditionally. Relay fixed sync cost ~30 ms,
     # negligible against multi-second measurement windows.
     with jax.set_mesh(mesh):
-        for _ in range(warmup):
+        # First call = XLA compile + one step; timed separately so the
+        # bench reports steady-state AND incl-compile MFU (the trainer's
+        # MFU line got the same split — BENCH_NOTES r03->r05 drift).
+        t_compile = time.perf_counter()
+        state, metrics = step(state, batch)
+        float(metrics["loss"])
+        compile_s = time.perf_counter() - t_compile
+        for _ in range(max(0, warmup - 1)):
             state, metrics = step(state, batch)
         float(metrics["loss"])
 
@@ -107,6 +122,9 @@ def inner() -> None:
     # Nominal 1 TFLOP/s off-TPU so the bench still emits numbers anywhere.
     peak = chip_peak_flops(device) or 1e12
     mfu = achieved / peak
+    # What a short job actually sees: steps+1 steps including the compile.
+    tps_incl = tokens_per_step * (steps + 1) / (dt + compile_s)
+    mfu_incl = tps_incl * train_flops_per_token / peak
 
     print(json.dumps({
         "metric": f"{model} train MFU (1 chip, bs{batch_size}x{seq}, bf16)",
@@ -115,6 +133,11 @@ def inner() -> None:
         "vs_baseline": round(mfu / 0.35, 4),
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "step_time_s": round(dt / steps, 4),
+        "compile_time_s": round(compile_s, 2),
+        "mfu_incl_compile": round(mfu_incl, 4),
+        "accumulate_steps": accum,
+        "ce_chunk": ce_chunk,
+        "global_batch": batch_size,
         "loss": round(float(metrics["loss"]), 4),
         "platform": jax.default_backend(),
         "device": str(device),

@@ -68,6 +68,24 @@ RBT_BENCH_SKIP_SERVE=1 run bf16-state-dots \
   env RBT_BENCH_PARAM_DTYPE=bfloat16 RBT_BENCH_MU_DTYPE=bfloat16 \
   RBT_BENCH_REMAT=dots_saveable python bench.py
 
+# 2b. Training fast path (PR 2): gradient accumulation at EQUAL global
+#     batch (accum on/off — the delta is pure scan/accumulator overhead),
+#     then accum at a global batch the plain path cannot hold in HBM
+#     (bf16 state + full remat still OOMs bs64x2048 on a v5e-1; accum 8
+#     runs it at one-microbatch peak memory), and the chunked fused CE
+#     which drops the [b,s,v] f32 logits pair from the memory profile.
+RBT_BENCH_SKIP_SERVE=1 run train-accum-off-bs16 \
+  env RBT_BENCH_BS=16 python bench.py
+RBT_BENCH_SKIP_SERVE=1 run train-accum2-bs16 \
+  env RBT_BENCH_BS=16 RBT_BENCH_ACCUM=2 python bench.py
+RBT_BENCH_SKIP_SERVE=1 run train-accum8-bs64 \
+  env RBT_BENCH_BS=64 RBT_BENCH_ACCUM=8 python bench.py
+RBT_BENCH_SKIP_SERVE=1 run train-ce-chunk \
+  env RBT_BENCH_CE_CHUNK=512 python bench.py
+RBT_BENCH_SKIP_SERVE=1 run train-ce-chunk-accum8-bs64 \
+  env RBT_BENCH_CE_CHUNK=512 RBT_BENCH_BS=64 RBT_BENCH_ACCUM=8 \
+  python bench.py
+
 # 3. Serving: TTFT/decode baseline, chunked-decode ablation, slot /
 #    prefill-budget sweep, shared-prefix reuse (BENCH_NOTES queue).
 run serve-baseline python bench_serve.py

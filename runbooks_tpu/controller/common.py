@@ -58,10 +58,38 @@ def params_env(params: dict) -> List[dict]:
 # visible condition. `quantize` mirrors the reference's Server contract
 # (reference: examples/llama2-70b/server.yaml `quantize: int4`), consumed
 # by serve/api.load_model and models/loader.py.
+# Gradient accumulation (train/step.py make_train_step): microbatch count
+# per optimizer step. Power-of-two enum — a typo'd value would otherwise
+# surface only as a crash-looping trainer Job at ValueError time; accepted
+# under every spelling TrainJobConfig.from_params honors (snake_case
+# params.json convention, the reference's camelCase spec style, and the
+# PARAM_* env round-trip's lowercase).
+_ACCUM_KEYS = ("accumulate_steps", "accumulateSteps", "accumulatesteps")
+_ACCUM_ENUM = ("1", "2", "4", "8", "16", "32", "64")
+
 ENUM_PARAMS = {
     "quantize": ("none", "int8", "int4"),
     "source": ("huggingface", "dir", "random"),
+    **{k: _ACCUM_ENUM for k in _ACCUM_KEYS},
 }
+
+# Integer-valued params the trainer int()-coerces at startup: key ->
+# minimum allowed value. A non-integer or out-of-range value would
+# crash-loop the Job at TrainJobConfig.from_params instead of surfacing a
+# condition.
+INT_PARAMS = {
+    "loss_chunk": 0,
+    "prefetch_depth": 0,
+    "batch_size": 1,
+    "seq_len": 1,
+    "steps": 1,
+    "mesh_stage": 1,
+}
+
+# Keep in sync with TrainJobConfig.batch_size: the divisibility check must
+# hold against the default the trainer will actually use when the spec
+# leaves batch_size out.
+DEFAULT_TRAIN_BATCH_SIZE = 8
 
 
 def validate_params(params: dict) -> Optional[str]:
@@ -71,6 +99,32 @@ def validate_params(params: dict) -> Optional[str]:
         if val is not None and str(val) not in allowed:
             return (f"spec.params.{key}: {val!r} is not one of "
                     f"{'|'.join(allowed)}")
+    for key, lo in INT_PARAMS.items():
+        val = params.get(key)
+        if val is None:
+            continue
+        try:
+            if int(val) < lo:
+                return f"spec.params.{key}: {val} must be >= {lo}"
+        except (TypeError, ValueError):
+            return f"spec.params.{key}: {val!r} is not an integer"
+    accum = next((params[k] for k in _ACCUM_KEYS
+                  if params.get(k) is not None), None)
+    if accum is not None:
+        batch = params.get("batch_size", DEFAULT_TRAIN_BATCH_SIZE)
+        if int(batch) % int(accum):
+            return (f"spec.params.accumulate_steps: {accum} does not "
+                    f"divide batch_size {batch}")
+        # make_train_step rejects accumulation under the 1f1b pipeline
+        # schedule (it already microbatches); catch it at reconcile time
+        # rather than crash-looping the Job.
+        stages = int(params.get("mesh_stage", 1))
+        schedule = str((params.get("model_overrides") or {})
+                       .get("pipeline_schedule", "1f1b"))
+        if int(accum) > 1 and stages > 1 and schedule == "1f1b":
+            return ("spec.params.accumulate_steps: not supported with the "
+                    "1f1b pipeline schedule (mesh_stage > 1); set "
+                    "model_overrides.pipeline_microbatches instead")
     return None
 
 

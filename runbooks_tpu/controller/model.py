@@ -50,9 +50,11 @@ class ModelReconciler:
 
         err = validate_params(model.params)
         if err is not None:
-            # Invalid spec.params (e.g. quantize: int3, source: hf): a
-            # visible condition beats a crash-looping loader Job. Terminal
-            # until the spec changes — no requeue.
+            # Invalid spec.params (e.g. quantize: int3, source: hf, or an
+            # accumulateSteps that is not a power of two / does not divide
+            # batch_size): a visible condition beats a crash-looping
+            # loader/trainer Job. Terminal until the spec changes — no
+            # requeue.
             model.set_condition(cond.COMPLETE, False,
                                 cond.REASON_INVALID_PARAMS, err)
             model.commit_status(ctx.client)

@@ -608,10 +608,18 @@ def forward(
     cache_view: Optional[int] = None,
     remat: bool = False,
     with_aux: bool = False,
+    return_activations: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Returns (logits [b, s, vocab] float32, updated cache or None) — or,
     with_aux=True, (logits, cache, aux) where aux is the summed per-layer
     auxiliary loss (MoE load balance; 0.0 for dense models).
+
+    return_activations=True skips the head matmul and returns the
+    post-final-norm activations [b, s, hidden] in place of logits — the
+    input to the chunked fused cross-entropy (train/step.py
+    chunked_cross_entropy), which consumes activations + head weights in
+    sequence chunks so the [b, s, vocab] f32 logits tensor is never
+    materialized.
 
     Without cache: standard training/eval forward, causal + segment masking.
     With cache: tokens are appended at cache.index (prefill chunks or single-
@@ -745,6 +753,11 @@ def forward(
         new_cache = None
 
     x = _norm(cfg, params["final_norm"], x)
+    if return_activations:
+        x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+        if with_aux:
+            return x, new_cache, aux_total
+        return x, new_cache
     head = params["embed"].T if cfg.tie_embeddings else params["head"]
     # bf16 operands + f32 accumulation: the MXU accumulates in f32 either
     # way, but f32 operands run at 1/4 the bf16 MXU rate on v5e/v5p.

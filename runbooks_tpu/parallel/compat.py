@@ -1,0 +1,69 @@
+"""JAX version compatibility for the mesh-context API.
+
+The codebase is written against the modern mesh idiom (``jax.set_mesh`` +
+``jax.sharding.AxisType``), but the pinned container image may carry an
+older JAX (0.4.x) where neither exists and the ambient mesh is set with the
+legacy ``with mesh:`` context (``jax._src.mesh.thread_resources``).
+``parallel.sharding._current_mesh`` already reads both contexts; this module
+closes the gap on the *writer* side so one source tree runs on either API.
+
+``install()`` aliases ``jax.set_mesh`` to the legacy context manager when
+the real one is missing. It is called once from ``runbooks_tpu.parallel``
+(imported by every mesh consumer) and is a no-op on modern JAX.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def set_mesh(mesh: Mesh):
+    """Context manager installing ``mesh`` as the ambient mesh.
+
+    Modern JAX: delegates to ``jax.set_mesh`` (abstract mesh context).
+    Legacy JAX: a ``jax.sharding.Mesh`` is itself a context manager that
+    installs the physical mesh into thread resources — exactly what the
+    legacy pjit machinery (and our ``_current_mesh`` fallback) reads.
+    """
+    native = getattr(jax, "set_mesh", None)
+    if native is not None and native is not set_mesh:
+        return native(mesh)
+    return mesh
+
+
+def mesh_axis_types(n_axes: int):
+    """``(AxisType.Auto,) * n`` on modern JAX, None where AxisType (and the
+    axis_types= kwarg on jax.make_mesh) predates the running version."""
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if axis_type is None:
+        return None
+    return (axis_type.Auto,) * n_axes
+
+
+def _legacy_shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, **kwargs):
+    """``jax.shard_map`` signature adapter over the pre-0.5
+    ``jax.experimental.shard_map`` (check_vma was then called check_rep)."""
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if check_vma is not None:
+        kwargs.setdefault("check_rep", check_vma)
+    if "axis_names" in kwargs:
+        # Modern API names the MANUAL axes; the legacy auto= kwarg is the
+        # complement (axes left to the GSPMD partitioner).
+        manual = frozenset(kwargs.pop("axis_names"))
+        kwargs.setdefault("auto", frozenset(mesh.axis_names) - manual)
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+
+
+def install() -> None:
+    """Alias the modern mesh/shard_map entry points when absent."""
+    if not hasattr(jax, "set_mesh"):
+        jax.set_mesh = set_mesh
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _legacy_shard_map
+    if not hasattr(jax.lax, "axis_size"):
+        # psum of a literal 1 folds to the static axis size at trace time.
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)

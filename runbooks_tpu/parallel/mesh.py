@@ -81,11 +81,16 @@ def make_mesh(
     # Auto axis types = classic GSPMD: XLA propagates shardings from the
     # in/out_shardings + with_sharding_constraint hints. (JAX 0.9's default
     # under jax.set_mesh is the explicit sharding-in-types mode, which would
-    # require out_sharding annotations on every gather/einsum.)
-    axis_types = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+    # require out_sharding annotations on every gather/einsum.) On legacy
+    # JAX (no AxisType) every mesh is GSPMD-auto already.
+    from runbooks_tpu.parallel.compat import mesh_axis_types
+
+    axis_types = mesh_axis_types(len(MESH_AXES))
     try:
-        return jax.make_mesh(shape, MESH_AXES, devices=devices,
-                             axis_types=axis_types)
+        if axis_types is not None:
+            return jax.make_mesh(shape, MESH_AXES, devices=devices,
+                                 axis_types=axis_types)
+        return jax.make_mesh(shape, MESH_AXES, devices=devices)
     except TypeError:
         # Older jax.make_mesh lacks devices=/axis_types=; manual reshape.
         import numpy as np

@@ -701,6 +701,11 @@ def main() -> int:
     from runbooks_tpu.parallel.distributed import initialize
 
     initialize()
+    # Persistent compile cache (default: <artifacts>/jax_cache): a
+    # restarted serve worker skips the prefill/decode bucket recompiles.
+    from runbooks_tpu.utils.jax_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     cfg, model_params = load_model(params)
     tokenizer = load_tokenizer(params.get("tokenizer"))
 

@@ -142,35 +142,52 @@ def create_lora_train_state(model_cfg, lora_cfg: LoraConfig, base_params,
 
 
 def make_lora_train_step(model_cfg, lora_cfg: LoraConfig, optimizer, mesh,
-                         state_shardings, base_shardings, remat: bool = True):
+                         state_shardings, base_shardings, remat: bool = True,
+                         accumulate_steps: int = 1, loss_chunk: int = 0):
     """jit'ed (state, base_params, batch) -> (state, metrics); grads flow only
-    to the LoRA tree, base stays frozen (and may be bf16)."""
+    to the LoRA tree, base stays frozen (and may be bf16).
+
+    accumulate_steps/loss_chunk mirror make_train_step: k-microbatch
+    gradient accumulation with an f32 accumulator, and the chunked fused
+    cross-entropy that never materializes [b, s, vocab] logits (the merge
+    happens per microbatch inside the differentiated graph either way)."""
     import jax.numpy as jnp
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from runbooks_tpu.models.transformer import forward
-    from runbooks_tpu.train.step import TrainState, cross_entropy_loss
+    from runbooks_tpu.train.step import (
+        TrainState,
+        accumulated_value_and_grad,
+        make_ce_terms,
+    )
+
+    k = int(accumulate_steps)
+    if k < 1:
+        raise ValueError(f"accumulate_steps must be >= 1, got {k}")
+    ce_terms = make_ce_terms(model_cfg, remat, int(loss_chunk))
 
     def step_fn(state: "TrainState", base_params, batch):
-        def loss_fn(lora):
+        # Closures capture base_params per trace (construction is free at
+        # trace time — no mutable state shared across traces).
+        def lora_ce_terms(lora, mb):
             merged = apply_lora(base_params, lora, lora_cfg)
-            logits, _, aux = forward(
-                model_cfg, merged, batch["tokens"],
-                positions=batch.get("positions"),
-                segment_ids=batch.get("segment_ids"),
-                remat=remat,
-                with_aux=True,
-            )
-            loss, total = cross_entropy_loss(
-                logits, batch["targets"], batch.get("loss_mask"))
-            if model_cfg.moe_num_experts:
+            loss, total, aux = ce_terms(merged, mb)
+            if model_cfg.moe_num_experts and k == 1:
                 # Same objective as full fine-tuning: keep routing balanced
-                # while adapting (train/step.py does the same).
+                # while adapting (train/step.py does the same). The k>1
+                # path adds the aux term inside accumulated_value_and_grad.
                 loss = loss + model_cfg.moe_aux_coef * aux
-            return loss, total
+            return loss, total, aux
 
-        (loss, total), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+        if k > 1:
+            (loss, total), grads = accumulated_value_and_grad(
+                model_cfg, lora_ce_terms, k)(state.params, batch)
+        else:
+            def loss_fn(lora):
+                loss, total, _ = lora_ce_terms(lora, batch)
+                return loss, total
+
+            (loss, total), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_lora = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),

@@ -43,9 +43,20 @@ class TrainJobConfig:
     optimizer: OptimizerConfig = OptimizerConfig()
     lora: Optional[LoraConfig] = None
 
-    batch_size: int = 8
+    batch_size: int = 8           # global batch (microbatched when
+                                  # accumulate_steps > 1)
     seq_len: int = 512
     steps: int = 100
+    # Training fast path (docs/training-performance.md):
+    # accumulate_steps=k runs k microbatches of batch_size/k per optimizer
+    # step (peak activation memory of one microbatch); loss_chunk=c
+    # computes the loss via the chunked fused cross-entropy (the
+    # [b, s, vocab] f32 logits tensor is never materialized); 0 = off.
+    # prefetch_depth>0 tokenizes/packs ahead on a background thread and
+    # double-buffers jax.device_put with the mesh batch shardings.
+    accumulate_steps: int = 1
+    loss_chunk: int = 0
+    prefetch_depth: int = 2
     data_path: Optional[str] = None       # default: contract data dir
     tokenizer: Optional[str] = None
     text_key: str = "text"                # jsonl field holding the document
@@ -69,12 +80,26 @@ class TrainJobConfig:
         """Build from a flat params.json dict (the operator-facing config
         surface, like the reference's params -> PARAM_* convention)."""
         kwargs: Dict[str, Any] = {}
+        params = dict(params)
+        # The reference's spec style is camelCase; the env round-trip
+        # (PARAM_ACCUMULATESTEPS) lowercases it. Accept both spellings for
+        # the controller-validated key so a validated spec cannot silently
+        # train without accumulation.
+        for alias in ("accumulateSteps", "accumulatesteps"):
+            if alias in params:
+                params.setdefault("accumulate_steps", params.pop(alias))
         simple = {f.name for f in dataclasses.fields(cls)
                   if f.name not in ("mesh", "optimizer", "lora",
                                     "model_overrides")}
         for k, v in params.items():
             if k in simple:
                 kwargs[k] = v
+        # YAML specs quote freely ("8"); a str here would TypeError deep in
+        # run_training instead of at the validated boundary.
+        for key in ("accumulate_steps", "loss_chunk", "prefetch_depth",
+                    "batch_size", "seq_len", "steps"):
+            if key in kwargs:
+                kwargs[key] = int(kwargs[key])
         mesh_keys = {f.name for f in dataclasses.fields(MeshConfig)}
         mesh_args = {k[len("mesh_"):]: int(v) for k, v in params.items()
                      if k.startswith("mesh_") and k[len("mesh_"):] in mesh_keys}
@@ -100,9 +125,13 @@ def _batches(job: TrainJobConfig, model_cfg: ModelConfig) -> Iterator[dict]:
     if path and os.path.exists(path):
         tok = data_mod.load_tokenizer(job.tokenizer)
         vocab = getattr(tok, "vocab_size", model_cfg.vocab_size)
-        assert vocab <= model_cfg.vocab_size, (
-            f"tokenizer vocab {vocab} exceeds model vocab "
-            f"{model_cfg.vocab_size}")
+        if vocab > model_cfg.vocab_size:
+            # A real error, not an assert: `python -O` strips asserts and
+            # out-of-range token ids would then index-wrap into garbage
+            # embeddings mid-training.
+            raise ValueError(
+                f"tokenizer vocab {vocab} exceeds model vocab "
+                f"{model_cfg.vocab_size}")
         return data_mod.dataset(path, job.seq_len, job.batch_size,
                                 tokenizer=tok, epochs=None,
                                 text_key=job.text_key,
@@ -118,10 +147,22 @@ def run_training(job: TrainJobConfig,
     import os
 
     model_cfg = get_config(job.model, **job.model_overrides)
+    if job.accumulate_steps < 1:
+        raise ValueError(
+            f"accumulate_steps must be >= 1, got {job.accumulate_steps}")
+    if job.batch_size % job.accumulate_steps:
+        raise ValueError(
+            f"accumulate_steps={job.accumulate_steps} must divide "
+            f"batch_size={job.batch_size}")
     mesh = make_mesh(job.mesh)
     optimizer = make_optimizer(job.optimizer)
     artifacts = job.artifacts_dir or contract.artifacts_dir()
     os.makedirs(artifacts, exist_ok=True)
+    # Persistent compile cache in the durable artifacts mount: a restarted
+    # Job (slice restart / resume) skips the full XLA recompile.
+    from runbooks_tpu.utils.jax_cache import enable_compilation_cache
+
+    enable_compilation_cache(os.path.join(artifacts, "jax_cache"))
     ckpt = CheckpointManager(artifacts)
 
     rng = jax.random.key(job.seed)
@@ -151,10 +192,13 @@ def run_training(job: TrainJobConfig,
         state, shardings = create_lora_train_state(
             model_cfg, job.lora, base_params, optimizer, mesh, rng)
         step_fn = make_lora_train_step(
-            model_cfg, job.lora, optimizer, mesh, shardings, base_shardings)
+            model_cfg, job.lora, optimizer, mesh, shardings, base_shardings,
+            accumulate_steps=job.accumulate_steps, loss_chunk=job.loss_chunk)
     else:
         state, shardings = create_train_state(model_cfg, optimizer, mesh, rng)
-        step_fn = make_train_step(model_cfg, optimizer, mesh, shardings)
+        step_fn = make_train_step(model_cfg, optimizer, mesh, shardings,
+                                  accumulate_steps=job.accumulate_steps,
+                                  loss_chunk=job.loss_chunk)
 
     start_step = 0
     if job.resume and ckpt.latest_step() is not None:
@@ -162,6 +206,15 @@ def run_training(job: TrainJobConfig,
         start_step = int(state.step)
 
     batches = _batches(job, model_cfg)
+    prefetcher = None
+    if job.prefetch_depth > 0:
+        # Async input pipeline: tokenize/pack runs ahead on a background
+        # thread and batches land on device (sharded device_put) while the
+        # previous step computes — host work overlaps device compute
+        # instead of serializing with it inside the step loop.
+        batches = prefetcher = data_mod.Prefetcher(
+            batches, depth=job.prefetch_depth,
+            place=data_mod.device_placer(mesh))
     history = []
     tokens_per_step = job.batch_size * job.seq_len
     flops_per_token = 3.0 * model_cfg.flops_per_token(job.seq_len)
@@ -170,37 +223,63 @@ def run_training(job: TrainJobConfig,
     peak_flops = chip_peak_flops(jax.devices()[0]) * len(jax.devices())
     t_start = time.perf_counter()
     tokens_done = 0
+    compile_time_s = None
 
     profiling = False
-    with jax.set_mesh(mesh):
-        for i in range(start_step, job.steps):
-            if job.profile_stop > job.profile_start and i == job.profile_start:
-                jax.profiler.start_trace(os.path.join(artifacts, "profile"))
-                profiling = True
-            batch = {k: np.asarray(v) for k, v in next(batches).items()}
-            if lora_mode:
-                state, metrics = step_fn(state, base_params, batch)
-            else:
-                state, metrics = step_fn(state, batch)
-            if profiling and i + 1 == job.profile_stop:
-                jax.block_until_ready(metrics["loss"])
-                jax.profiler.stop_trace()
-                profiling = False
-            tokens_done += tokens_per_step
-            if (i + 1) % job.log_every == 0 or i + 1 == job.steps:
-                loss = float(metrics["loss"])
-                dt = time.perf_counter() - t_start
-                tps = tokens_done / dt
-                achieved = tps * flops_per_token
-                entry = {"step": i + 1, "loss": round(loss, 4),
-                         "tokens_per_sec": round(tps, 1),
-                         "tflops_per_sec": round(achieved / 1e12, 2)}
-                if peak_flops:
-                    entry["mfu"] = round(achieved / peak_flops, 4)
-                history.append(entry)
-                print(json.dumps(entry), flush=True)
-            if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:
-                ckpt.save(i + 1, state)
+    try:
+        with jax.set_mesh(mesh):
+            for i in range(start_step, job.steps):
+                if job.profile_stop > job.profile_start \
+                        and i == job.profile_start:
+                    jax.profiler.start_trace(
+                        os.path.join(artifacts, "profile"))
+                    profiling = True
+                batch = next(batches)
+                if prefetcher is None:
+                    batch = {k: np.asarray(v) for k, v in batch.items()}
+                if lora_mode:
+                    state, metrics = step_fn(state, base_params, batch)
+                else:
+                    state, metrics = step_fn(state, batch)
+                if i == start_step:
+                    # The first step folds the XLA compile; pulling the
+                    # loss waits for it, then the throughput window resets
+                    # so tokens/sec and MFU report steady-state compute
+                    # (compile time lands in its own field).
+                    float(metrics["loss"])
+                    compile_time_s = time.perf_counter() - t_start
+                    t_start = time.perf_counter()
+                else:
+                    tokens_done += tokens_per_step
+                if profiling and i + 1 == job.profile_stop:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
+                if (i + 1) % job.log_every == 0 or i + 1 == job.steps:
+                    # Only log points sync on the device (float pulls the
+                    # scalar); between them steps dispatch async with
+                    # metrics buffered as device arrays.
+                    loss = float(metrics["loss"])
+                    dt = time.perf_counter() - t_start
+                    if tokens_done:
+                        tps = tokens_done / max(dt, 1e-9)
+                    else:  # single measured step: only the compile window
+                        tps = tokens_per_step / max(compile_time_s, 1e-9)
+                    achieved = tps * flops_per_token
+                    entry = {"step": i + 1, "loss": round(loss, 4),
+                             "tokens_per_sec": round(tps, 1),
+                             "tflops_per_sec": round(achieved / 1e12, 2)}
+                    if peak_flops:
+                        entry["mfu"] = round(achieved / peak_flops, 4)
+                    if not history and compile_time_s is not None:
+                        entry["compile_time_s"] = round(compile_time_s, 2)
+                    history.append(entry)
+                    print(json.dumps(entry), flush=True)
+                if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:
+                    ckpt.save(i + 1, state)
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
 
     if profiling:  # profile window ran past the last step
         jax.profiler.stop_trace()
@@ -209,6 +288,8 @@ def run_training(job: TrainJobConfig,
         "final_loss": history[-1]["loss"] if history else None,
         "steps": job.steps,
         "tokens_per_sec": history[-1]["tokens_per_sec"] if history else None,
+        "compile_time_s": compile_time_s,
+        "accumulate_steps": job.accumulate_steps,
         "model": job.model,
         "lora": lora_mode,
         "history": history,

@@ -1,0 +1,64 @@
+"""Persistent JAX compilation cache under the artifacts dir.
+
+A restarted trainer Job (slice restart with resume — controller/model.py)
+or serve worker otherwise pays the full XLA compile again; pointing JAX's
+persistent compilation cache at the durable artifacts mount
+(/content/artifacts per the container contract) makes restarts start
+stepping in seconds instead of minutes. Worth real money on TPU: the chips
+idle for the whole recompile.
+
+Env knobs:
+  RBT_JAX_CACHE=0                disable entirely
+  RBT_JAX_CACHE=1                force-enable (including on CPU, see below)
+  JAX_COMPILATION_CACHE_DIR      override the cache location
+
+CPU is opt-in only: deserializing a warm cache entry on the CPU backend of
+older jaxlib (0.4.x) corrupts the heap ("corrupted double-linked list" /
+segfault on the run AFTER the one that wrote the cache — reproduced with a
+two-process resume against one artifacts dir). The accelerator backends,
+where the recompile actually costs money, are the production contract and
+stay enabled by default.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (default:
+    $JAX_COMPILATION_CACHE_DIR, else <artifacts>/jax_cache). Returns the
+    directory in use, or None when disabled/unavailable. Safe to call more
+    than once and before/after other jax.config use; never raises — a
+    missing cache is a perf bug, not a correctness one."""
+    force = os.environ.get("RBT_JAX_CACHE")
+    if force == "0":
+        return None
+    try:
+        import jax
+
+        if force != "1" and jax.default_backend() == "cpu":
+            return None  # known-crashy warm-read path (module docstring)
+
+        if cache_dir is None:
+            cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        if cache_dir is None:
+            from runbooks_tpu.utils import contract
+
+            cache_dir = os.path.join(contract.artifacts_dir(), "jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every compile that takes noticeable time: the default
+        # 1s floor skips the many small serve/trainer helper jits whose
+        # compiles still add up across a restart.
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.2)
+        except Exception:
+            pass  # knob renamed/absent on some versions; dir alone works
+        return cache_dir
+    except Exception as exc:
+        print(f"jax_cache: persistent compilation cache disabled ({exc!r})",
+              flush=True)
+        return None

@@ -517,6 +517,42 @@ def test_model_single_pod_failure_stays_terminal(harness):
     assert c["reason"] == cond.REASON_JOB_FAILED
 
 
+def test_model_invalid_accumulate_steps_surfaces_condition(harness):
+    """A bad spec.params.accumulateSteps (non-power-of-two, or not dividing
+    batch_size) must become an InvalidParams condition on the Model, not a
+    ValueError crash-loop in the trainer Job."""
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("am", spec={
+        "image": "img",
+        "params": {"model": "debug", "accumulateSteps": 3}}).obj)
+    mgr.reconcile_until_stable()
+    cur = Model(get(client, "Model", "am"))
+    c = ko.get_condition(cur.obj, cond.COMPLETE)
+    assert c["status"] == "False"
+    assert c["reason"] == cond.REASON_INVALID_PARAMS
+    assert "accumulateSteps" in c["message"]
+
+    # Power-of-two but not dividing batch_size: still invalid.
+    cur.obj["spec"]["params"] = {"model": "debug", "accumulate_steps": 4,
+                                 "batch_size": 6}
+    client.update(cur.obj)
+    mgr.reconcile_until_stable()
+    c = ko.get_condition(Model(get(client, "Model", "am")).obj,
+                         cond.COMPLETE)
+    assert c["reason"] == cond.REASON_INVALID_PARAMS
+    assert "divide" in c["message"]
+
+    # Fixing the spec clears the gate (the modeller Job gets created).
+    cur = Model(get(client, "Model", "am"))
+    cur.obj["spec"]["params"] = {"model": "debug", "accumulate_steps": 4,
+                                 "batch_size": 8}
+    client.update(cur.obj)
+    mgr.reconcile_until_stable()
+    c = ko.get_condition(Model(get(client, "Model", "am")).obj,
+                         cond.COMPLETE)
+    assert c["reason"] != cond.REASON_INVALID_PARAMS
+
+
 def test_server_invalid_quantize_param_surfaces_condition(harness):
     """A typo'd spec.params.quantize must become a visible condition, not a
     crash-looping serve container behind a never-ready Deployment."""

@@ -67,6 +67,64 @@ def test_trainer_with_jsonl_data_and_lora(tmp_path):
     assert os.path.exists(tmp_path / "lora.json")
 
 
+def test_from_params_accumulate_aliases_and_string_ints():
+    # camelCase (reference spec style) and env-lowercased spellings both
+    # land on accumulate_steps, and YAML-quoted ints coerce — a
+    # controller-validated spec must not silently drop accumulation or
+    # TypeError mid-job.
+    j = TrainJobConfig.from_params({"accumulateSteps": "8",
+                                    "batch_size": "64"})
+    assert j.accumulate_steps == 8 and j.batch_size == 64
+    j = TrainJobConfig.from_params({"accumulatesteps": 4})
+    assert j.accumulate_steps == 4
+    j = TrainJobConfig.from_params({"accumulate_steps": 2,
+                                    "accumulateSteps": 16})
+    assert j.accumulate_steps == 2  # snake_case wins
+
+
+def test_trainer_fast_path_accum_chunk_prefetch(tmp_path):
+    # The whole training fast path at once: 2-way grad accumulation,
+    # chunked fused CE, and the async prefetcher (default depth 2).
+    summary = run_training(job(
+        tmp_path, steps=4, accumulate_steps=2, loss_chunk=16))
+    assert summary["final_loss"] is not None
+    assert summary["accumulate_steps"] == 2
+    # Compile time is reported separately and excluded from the
+    # steady-state tokens/sec window (the first-step reset).
+    assert summary["compile_time_s"] is not None
+    assert summary["compile_time_s"] > 0
+    assert summary["history"][0]["compile_time_s"] == round(
+        summary["compile_time_s"], 2)
+    assert summary["tokens_per_sec"] > 0
+
+
+def test_trainer_accum_must_divide_batch(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError, match="divide"):
+        run_training(job(tmp_path, steps=2, accumulate_steps=3))
+
+
+def test_trainer_rejects_oversized_tokenizer_vocab(tmp_path):
+    import json as _json
+
+    import pytest
+
+    data = tmp_path / "data"
+    os.makedirs(data)
+    with open(data / "docs.jsonl", "w") as f:
+        f.write(_json.dumps({"text": "hello"}) + "\n")
+    # Byte tokenizer vocab is 258 > the overridden model vocab of 128:
+    # must raise (not assert — python -O would strip an assert).
+    import dataclasses
+
+    small_vocab = dataclasses.replace(
+        job(tmp_path, steps=1, data_path=str(data)),
+        model_overrides={"dtype": "float32", "vocab_size": 128})
+    with pytest.raises(ValueError, match="vocab"):
+        run_training(small_vocab)
+
+
 def test_params_env_roundtrip(monkeypatch):
     monkeypatch.setenv("PARAM_STEPS", "7")
     monkeypatch.setenv("PARAM_MODEL", "debug")
