@@ -72,9 +72,27 @@ def inner() -> None:
     # chunked fused CE (no [b, s, vocab] f32 logits tensor).
     accum = int(os.environ.get("RBT_BENCH_ACCUM", "1"))
     ce_chunk = int(os.environ.get("RBT_BENCH_CE_CHUNK", "0"))
+    # Overlapped collective-matmul axis (docs/tensor-parallel-performance
+    # .md): RBT_BENCH_MESH_TENSOR=k runs the same train step on a k-way
+    # tensor-parallel mesh (needs k devices on the platform) and
+    # RBT_BENCH_COLLECTIVE=off|ring|auto picks GSPMD blocking collectives
+    # vs the ppermute ring — the off/ring pair at equal shape is the
+    # overlap win, isolated.
+    mesh_tensor = int(os.environ.get("RBT_BENCH_MESH_TENSOR", "1"))
+    # "0" means "skip the collective pass" to the multichip dryrun
+    # (__graft_entry__.py); here it just keeps the config default rather
+    # than tracing a bogus mode.
+    cm_env = os.environ.get("RBT_BENCH_COLLECTIVE")
+    if cm_env and cm_env != "0":
+        overrides["collective_matmul"] = cm_env
 
     cfg = get_config(model, **overrides)
-    mesh = single_device_mesh()
+    if mesh_tensor > 1:
+        from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(tensor=mesh_tensor, fsdp=-1))
+    else:
+        mesh = single_device_mesh()
     opt = make_optimizer(OptimizerConfig(
         total_steps=10_000, warmup_steps=10,
         mu_dtype=os.environ.get("RBT_BENCH_MU_DTYPE") or None))
@@ -120,7 +138,10 @@ def inner() -> None:
     train_flops_per_token = 3.0 * cfg.flops_per_token(seq)
     achieved = tokens_per_sec * train_flops_per_token
     # Nominal 1 TFLOP/s off-TPU so the bench still emits numbers anywhere.
-    peak = chip_peak_flops(device) or 1e12
+    # A multi-chip mesh (RBT_BENCH_MESH_TENSOR) measures whole-mesh
+    # throughput, so MFU normalizes by the whole mesh's peak.
+    n_chips = len(mesh.devices.flat) if mesh_tensor > 1 else 1
+    peak = (chip_peak_flops(device) or 1e12) * n_chips
     mfu = achieved / peak
     # What a short job actually sees: steps+1 steps including the compile.
     tps_incl = tokens_per_step * (steps + 1) / (dt + compile_s)
@@ -137,6 +158,8 @@ def inner() -> None:
         "mfu_incl_compile": round(mfu_incl, 4),
         "accumulate_steps": accum,
         "ce_chunk": ce_chunk,
+        "mesh_tensor": mesh_tensor,
+        "collective_matmul": cfg.collective_matmul,
         "global_batch": batch_size,
         "loss": round(float(metrics["loss"]), 4),
         "platform": jax.default_backend(),

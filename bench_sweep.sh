@@ -97,6 +97,19 @@ run serve-prefix env RBT_BENCH_PROMPT=512 RBT_BENCH_PREFIX=448 \
 run serve-prefix-ctl env RBT_BENCH_PROMPT=512 RBT_BENCH_MAXSEQ=1024 \
   python bench_serve.py
 
+# 4a. Overlapped collective matmul (ops/collective_matmul.py): the train
+#     bench on an 8-way tensor mesh, GSPMD blocking collectives vs the
+#     ppermute ring at the same shape — the off/ring step-time pair is
+#     the overlap win, isolated. The CPU-side parity/shape evidence is
+#     the dryrun's RBT_BENCH_COLLECTIVE pass (committed under
+#     bench_logs/*collective-matmul-cpu.log).
+RBT_BENCH_SKIP_SERVE=1 run train-tp8-gspmd \
+  env RBT_BENCH_MESH_TENSOR=8 RBT_BENCH_COLLECTIVE=off python bench.py
+RBT_BENCH_SKIP_SERVE=1 run train-tp8-ring \
+  env RBT_BENCH_MESH_TENSOR=8 RBT_BENCH_COLLECTIVE=ring python bench.py
+run collective-dryrun python -c \
+  "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
 # 4. Quantized serving fast path (int8/int4 weight-only + int8 KV): decode
 #    is bandwidth-bound, so fewer bytes streamed per token = more tok/s at
 #    equal batch, and the int4 tier is what fits 70B on a v5e-8. Same

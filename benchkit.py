@@ -137,7 +137,10 @@ def measure_outer(script: str, fallback_metric: str, unit: str) -> dict:
                     errors.append(f"tpu attempt 2: {err}")
 
     if result is None:
-        result, err, _ = _run_inner(script, cpu_env(), cpu_timeout)
+        # A multi-chip bench axis (RBT_BENCH_MESH_TENSOR) still needs that
+        # many devices on the CPU fallback — virtualize them.
+        n_cpu = max(1, int(os.environ.get("RBT_BENCH_MESH_TENSOR", "1")))
+        result, err, _ = _run_inner(script, cpu_env(n_cpu), cpu_timeout)
         if result is None:
             errors.append(f"cpu attempt: {err}")
 

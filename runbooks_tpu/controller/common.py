@@ -67,10 +67,18 @@ def params_env(params: dict) -> List[dict]:
 _ACCUM_KEYS = ("accumulate_steps", "accumulateSteps", "accumulatesteps")
 _ACCUM_ENUM = ("1", "2", "4", "8", "16", "32", "64")
 
+# Overlapped collective-matmul tensor parallelism (train AND serve specs;
+# docs/tensor-parallel-performance.md). Same spelling set as accumulate:
+# snake_case params.json, the reference's camelCase spec style, and the
+# PARAM_* env round-trip's lowercase.
+_CM_KEYS = ("collective_matmul", "collectiveMatmul", "collectivematmul")
+_CM_ENUM = ("off", "ring", "auto")
+
 ENUM_PARAMS = {
     "quantize": ("none", "int8", "int4"),
     "source": ("huggingface", "dir", "random"),
     **{k: _ACCUM_ENUM for k in _ACCUM_KEYS},
+    **{k: _CM_ENUM for k in _CM_KEYS},
 }
 
 # Integer-valued params the trainer int()-coerces at startup: key ->

@@ -19,6 +19,38 @@ from typing import Optional
 
 import jax.numpy as jnp
 
+# Allowed collective_matmul modes (framework-side single source of truth;
+# the controller's jax-free validation table mirrors it, like quantize).
+COLLECTIVE_MATMUL_MODES = ("off", "ring", "auto")
+# Accepted spec.params spellings: snake_case params.json convention, the
+# reference's camelCase spec style, and the PARAM_* env round-trip's
+# lowercase — same set the controller validates and the trainer aliases.
+COLLECTIVE_MATMUL_PARAM_KEYS = (
+    "collective_matmul", "collectiveMatmul", "collectivematmul")
+
+
+def check_collective_matmul(mode: str) -> str:
+    """Validate a collective_matmul mode string (single source for the
+    error message — transformer/serve/trainer all funnel through here,
+    mirroring ops.quantization.resolve_quantize_mode)."""
+    mode = str(mode)
+    if mode not in COLLECTIVE_MATMUL_MODES:
+        raise ValueError(
+            f"unknown collective_matmul {mode!r}; expected "
+            f"{'|'.join(COLLECTIVE_MATMUL_MODES)}")
+    return mode
+
+
+def resolve_collective_matmul_param(params: dict) -> Optional[str]:
+    """First present spelling of the collective_matmul contract param,
+    validated; None when the spec doesn't set it. Shared by the serving
+    entrypoint and anything else reading a raw params dict, so a
+    controller-validated spec can never be silently ignored over a
+    spelling mismatch."""
+    val = next((params[k] for k in COLLECTIVE_MATMUL_PARAM_KEYS
+                if params.get(k) is not None), None)
+    return None if val is None else check_collective_matmul(val)
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
@@ -88,6 +120,24 @@ class ModelConfig:
     # sequence-parallel mesh pays the HBM-materialized-scores cost that
     # flash exists to avoid (measured 0.10-0.23 vs 0.44 MFU single-chip).
     ring_flash_inner: Optional[bool] = None
+
+    # Overlapped collective-matmul tensor parallelism
+    # (ops/collective_matmul.py): decompose the per-layer TP collectives
+    # into lax.ppermute ring steps hidden behind per-shard partial dots —
+    # ring all-gather-matmul for the column-parallel q/k/v/gate/up
+    # projections, matmul-reduce-scatter for the row-parallel o/down
+    # projections (the post-dot all-reduce never exists; the residual
+    # stream stays tensor-sharded between layers). "off" (default) keeps
+    # the GSPMD collectives — the parity-oracle reference path; "ring"
+    # requests the ring; "auto" = ring whenever the active mesh has
+    # tensor > 1 ("ring" and "auto" resolve identically today). The
+    # pipeline (stage > 1) path always keeps GSPMD TP (see
+    # transformer.resolve_collective_matmul); weights whose shapes don't
+    # divide the ring fall back per-matmul.
+    collective_matmul: str = "off"
+    # Circulate ring shards in both directions, halving sequential hop
+    # count (takes effect at tensor > 2; a 2-ring has one hop either way).
+    collective_matmul_bidirectional: bool = True
 
     # Embedding lookup as one-hot matmul instead of gather. Under a
     # tensor-sharded vocab, GSPMD partitions the matmul cleanly where the

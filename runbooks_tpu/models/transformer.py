@@ -49,17 +49,77 @@ Params = Dict[str, Any]
 FLASH_CACHED_PREFILL_MIN_Q = 16
 
 
-def _matmul(x: jax.Array, w, ad) -> jax.Array:
+def _matmul(x: jax.Array, w, ad, ring: Optional[str] = None,
+            ring_bidir: bool = True) -> jax.Array:
     """x[..., k] @ w[k, out] in the activation dtype, f32 accumulation.
     Weight-only-quantized layers (QuantizedArray) take the fused
     dequant-matmul: integer blocks enter the einsum directly and the
     per-block scales apply post-dot (ops/quantization.py), so the bf16
     weight is never materialized — the point of weight-only quantization
-    on the bandwidth-bound decode path."""
+    on the bandwidth-bound decode path.
+
+    ring ("ag" column-parallel | "rs" row-parallel, None = off) selects
+    the overlapped collective matmul (ops/collective_matmul.py): the
+    tensor-parallel collective decomposes into ppermute ring steps hidden
+    behind per-shard partial dots instead of GSPMD's blocking
+    all-gather/all-reduce. Falls back to the GSPMD path per-weight when
+    the shapes don't divide the ring (ring_supported)."""
+    if ring is not None:
+        from runbooks_tpu.ops.collective_matmul import (
+            matmul_reduce_scatter,
+            ring_ag_matmul,
+            ring_supported,
+        )
+        from runbooks_tpu.parallel.sharding import _current_mesh
+
+        mesh = _current_mesh()
+        if ring_supported(ring, x.shape, w, mesh):
+            fn = ring_ag_matmul if ring == "ag" else matmul_reduce_scatter
+            return fn(x, w, mesh=mesh, compute_dtype=ad,
+                      bidirectional=ring_bidir).astype(ad)
     if isinstance(w, QuantizedArray):
         return quantized_matmul(x, w, compute_dtype=ad).astype(ad)
     return jnp.einsum("...k,ko->...o", x, w.astype(ad),
                       preferred_element_type=jnp.float32).astype(ad)
+
+
+def resolve_collective_matmul(cfg: ModelConfig) -> bool:
+    """Resolve cfg.collective_matmul ("off" | "ring" | "auto") against the
+    active mesh: the ring path runs only when the mesh tensor-parallelizes
+    ("auto" and "ring" are equivalent today — "ring" states intent, "auto"
+    may later grow heuristics). The pipeline (stage > 1) path keeps GSPMD
+    tensor parallelism: its blocks already run inside a stage-manual
+    shard_map, and nesting the ring's manual region there trips the pinned
+    jaxlib's partial-manual SPMD limitation (see tests/conftest.py
+    probe)."""
+    from runbooks_tpu.models.config import check_collective_matmul
+
+    mode = check_collective_matmul(cfg.collective_matmul)
+    if mode == "off":
+        return False
+    from runbooks_tpu.parallel.sharding import _current_mesh
+
+    mesh = _current_mesh()
+    if mesh is None or int(mesh.shape.get("tensor", 1)) <= 1:
+        return False
+    if int(mesh.shape.get("stage", 1)) > 1:
+        return False
+    return True
+
+
+def _act_embed_rules(ring_on: bool):
+    """Sharding rules for the residual stream. With the ring path on, the
+    hidden axis of every [b, s, h] activation shards over tensor: the
+    row-parallel matmul-reduce-scatter leaves it that way and the next
+    column-parallel ring re-gathers it behind its dots — an exposed
+    all-gather between them would give back exactly what the overlap
+    bought. Norms on the sharded stream cost one [b, s] partial-sum
+    all-reduce, inserted by GSPMD."""
+    if not ring_on:
+        return None
+    from runbooks_tpu.parallel.sharding import DEFAULT_RULES
+
+    return {**DEFAULT_RULES, "act_embed": "tensor"}
 
 
 # ---------------------------------------------------------------------------
@@ -430,9 +490,13 @@ def _attention_block(
 ):
     b, s, _ = x.shape
     ad = cfg.activation_dtype
+    ring_on = resolve_collective_matmul(cfg)
+    ring_col = "ag" if ring_on else None
+    ring_row = "rs" if ring_on else None
+    bidir = cfg.collective_matmul_bidirectional
 
     def proj(w, bname):
-        y = _matmul(x, w, ad)
+        y = _matmul(x, w, ad, ring=ring_col, ring_bidir=bidir)
         if bname in p:
             y = y + p[bname].astype(ad)
         return y
@@ -525,7 +589,7 @@ def _attention_block(
         out = _dispatch_attention(cfg, q, k, v, positions, segment_ids,
                                   mask, bias)
     out = out.reshape(b, s, cfg.q_dim)
-    out = _matmul(out, p["wo"], ad)
+    out = _matmul(out, p["wo"], ad, ring=ring_row, ring_bidir=bidir)
     if "bo" in p:
         out = out + p["bo"].astype(ad)
     return out, new_layer_cache
@@ -533,24 +597,28 @@ def _attention_block(
 
 def _mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
     ad = cfg.activation_dtype
+    ring_on = resolve_collective_matmul(cfg)
+    bidir = cfg.collective_matmul_bidirectional
 
-    def mm(y, w):
-        return _matmul(y, w, ad)
+    def mm(y, w, ring=None):
+        return _matmul(y, w, ad, ring=ring, ring_bidir=bidir)
 
+    ring_col = "ag" if ring_on else None
+    ring_row = "rs" if ring_on else None
     if cfg.gated_mlp:
-        gate = mm(x, p["wi_gate"])
-        up = mm(x, p["wi_up"])
+        gate = mm(x, p["wi_gate"], ring_col)
+        up = mm(x, p["wi_up"], ring_col)
         if "bi_gate" in p:
             gate = gate + p["bi_gate"].astype(ad)
             up = up + p["bi_up"].astype(ad)
         hidden = _activation(cfg, gate) * up
     else:
-        hidden = mm(x, p["wi"])
+        hidden = mm(x, p["wi"], ring_col)
         if "bi" in p:
             hidden = hidden + p["bi"].astype(ad)
         hidden = _activation(cfg, hidden)
     hidden = with_logical_constraint(hidden, ("batch", "seq", "act_mlp"))
-    out = mm(hidden, p["wo"])
+    out = mm(hidden, p["wo"], ring_row)
     if "bo" in p:
         out = out + p["bo"].astype(ad)
     return out
@@ -568,7 +636,9 @@ def _ffn_block(cfg: ModelConfig, layer: Params, x: jax.Array):
 def _block(cfg: ModelConfig, layer: Params, x, positions, segment_ids, mask,
            bias, layer_cache):
     """One transformer block. x: [b, s, h]. Returns (x, cache, aux)."""
-    x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+    act_rules = _act_embed_rules(resolve_collective_matmul(cfg))
+    x = with_logical_constraint(x, ("batch", "seq", "act_embed"),
+                                rules=act_rules)
     h1 = _norm(cfg, layer["ln1"], x)
     attn_out, new_cache = _attention_block(
         cfg, layer["attn"], h1, positions, segment_ids, mask, bias,
@@ -589,7 +659,8 @@ def _block(cfg: ModelConfig, layer: Params, x, positions, segment_ids, mask,
         h2 = _norm(cfg, layer["ln2"], x)
         ffn_out, aux = _ffn_block(cfg, layer, h2)
         x = x + ffn_out
-    x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+    x = with_logical_constraint(x, ("batch", "seq", "act_embed"),
+                                rules=act_rules)
     return x, new_cache, aux
 
 
@@ -664,6 +735,13 @@ def forward(
         x = x * (cfg.hidden_size ** 0.5)
     if cfg.position_type == "learned":
         x = x + params["pos_embed"].astype(ad)[positions]
+    # Deliberately the DEFAULT (replicated-h) constraint even when the
+    # ring path tensor-shards the residual stream: constraining the
+    # one-hot embed einsum's output tensor-sharded while its vocab
+    # contraction is also tensor-sharded miscompiles on the pinned
+    # jaxlib's SPMD partitioner (wrong VALUES, reproduced and bisected —
+    # not just a slow reshard). The first block's constraint shards the
+    # stream one op later, which the partitioner handles correctly.
     x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
     # Mask & bias over the full kv extent (or the static read view).
@@ -754,7 +832,9 @@ def forward(
 
     x = _norm(cfg, params["final_norm"], x)
     if return_activations:
-        x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+        act_rules = _act_embed_rules(resolve_collective_matmul(cfg))
+        x = with_logical_constraint(x, ("batch", "seq", "act_embed"),
+                                    rules=act_rules)
         if with_aux:
             return x, new_cache, aux_total
         return x, new_cache

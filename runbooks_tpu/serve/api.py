@@ -78,6 +78,17 @@ def load_model(params: dict) -> Tuple[ModelConfig, Any]:
     overrides = {"quantize": quantize}
     if params.get("quantize_kv") is not None:
         overrides["quantize_kv"] = bool(params["quantize_kv"])
+    # Overlapped ring tensor parallelism for the serve engine's
+    # prefill/decode programs (docs/tensor-parallel-performance.md);
+    # takes effect with a mesh_tensor > 1 serving mesh. One shared
+    # resolver covers every spelling the controller validates — a
+    # validated spec must not silently serve without the ring — and
+    # rejects typos here, before warmup compiles anything.
+    from runbooks_tpu.models.config import resolve_collective_matmul_param
+
+    cm = resolve_collective_matmul_param(params)
+    if cm is not None:
+        overrides["collective_matmul"] = cm
     cfg = _dc.replace(cfg, **overrides)
     ckpt_dir = params.get("checkpoint") or contract.model_dir()
     import os

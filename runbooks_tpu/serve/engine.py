@@ -405,6 +405,7 @@ class InferenceEngine:
         traffic with this on (costs len(buckets) extra warmup compiles)."""
         if rows is None:
             rows = (1, self.max_slots) if self.max_slots > 1 else (1,)
+        n_prefix = n_prefill = 0
         if prefix_build:
             for bucket in self.prefill_buckets:
                 toks = np.zeros((1, bucket), np.int32)
@@ -413,8 +414,10 @@ class InferenceEngine:
                 with self._mesh_ctx():
                     self._prefix_build(self.params, jnp.asarray(toks),
                                        jnp.asarray(pos))
+                n_prefix += 1
+        row_set = list(dict.fromkeys(min(r, self.max_slots) for r in rows))
         for bucket in self.prefill_buckets:
-            for r in dict.fromkeys(min(r, self.max_slots) for r in rows):
+            for r in row_set:
                 padded = np.zeros((r, bucket), np.int32)
                 positions = np.full((r, bucket), self._pad_slot, np.int32)
                 positions[:, :2] = [0, 1]
@@ -425,6 +428,7 @@ class InferenceEngine:
                         jnp.zeros(r, jnp.int32), jnp.ones(r, jnp.int32),
                         jax.random.key(0), jnp.zeros(r, jnp.float32),
                         jnp.zeros(r, jnp.int32), jnp.ones(r, jnp.float32))
+                n_prefill += 1
         zeros = np.zeros(self.max_slots, np.int32)
         for view in self.view_buckets:
             with self._mesh_ctx():
@@ -439,6 +443,17 @@ class InferenceEngine:
                     jnp.full(self.max_slots, -1, jnp.int32),
                     jnp.zeros(self.max_slots, jnp.int32),
                     jnp.zeros(self.max_slots, bool))
+        # One-line compiled-program census: model-config variants (e.g.
+        # collective_matmul, quantized tiers) multiply the per-shape
+        # program set, and a silently ballooning warmup is a compile-time
+        # regression nobody notices until readiness stalls — make the
+        # count visible per run.
+        print(
+            f"serve: warmup census: {n_prefill} prefill programs "
+            f"({len(self.prefill_buckets)} buckets {self.prefill_buckets} "
+            f"x rows {row_set}), {len(self.view_buckets)} decode views "
+            f"{self.view_buckets}, {n_prefix} prefix builders",
+            flush=True)
         self.reset()
 
     # ------------------------------------------------------------------

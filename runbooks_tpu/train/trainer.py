@@ -57,6 +57,10 @@ class TrainJobConfig:
     accumulate_steps: int = 1
     loss_chunk: int = 0
     prefetch_depth: int = 2
+    # Overlapped collective-matmul tensor parallelism ("off"|"ring"|"auto",
+    # docs/tensor-parallel-performance.md): overrides the model config's
+    # collective_matmul when set. "auto" rings whenever mesh_tensor > 1.
+    collective_matmul: Optional[str] = None
     data_path: Optional[str] = None       # default: contract data dir
     tokenizer: Optional[str] = None
     text_key: str = "text"                # jsonl field holding the document
@@ -88,6 +92,11 @@ class TrainJobConfig:
         for alias in ("accumulateSteps", "accumulatesteps"):
             if alias in params:
                 params.setdefault("accumulate_steps", params.pop(alias))
+        from runbooks_tpu.models.config import COLLECTIVE_MATMUL_PARAM_KEYS
+
+        for alias in COLLECTIVE_MATMUL_PARAM_KEYS[1:]:
+            if alias in params:
+                params.setdefault("collective_matmul", params.pop(alias))
         simple = {f.name for f in dataclasses.fields(cls)
                   if f.name not in ("mesh", "optimizer", "lora",
                                     "model_overrides")}
@@ -147,6 +156,14 @@ def run_training(job: TrainJobConfig,
     import os
 
     model_cfg = get_config(job.model, **job.model_overrides)
+    if job.collective_matmul is not None:
+        # Fail at the validated boundary, not mid-compile: the
+        # controller's validate_params enforces the same enum.
+        from runbooks_tpu.models.config import check_collective_matmul
+
+        model_cfg = dataclasses.replace(
+            model_cfg,
+            collective_matmul=check_collective_matmul(job.collective_matmul))
     if job.accumulate_steps < 1:
         raise ValueError(
             f"accumulate_steps must be >= 1, got {job.accumulate_steps}")

@@ -13,6 +13,15 @@ import pytest
 from runbooks_tpu.models.config import get_config
 from runbooks_tpu.models.transformer import forward, init_params
 from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+from tests.conftest import partial_manual_shard_map_broken
+
+# The stage-manual (partial-manual) shard_map these tests exercise cannot
+# be SPMD-partitioned on old jaxlibs (PartitionId limitation) — probe once
+# and skip instead of carrying known-red tests (tests/conftest.py).
+needs_partial_manual = pytest.mark.skipif(
+    partial_manual_shard_map_broken(),
+    reason="old-jaxlib SPMD PartitionId limitation: partial-manual "
+           "(stage) shard_map cannot be partitioned")
 
 
 def pp_cfg(**over):
@@ -28,6 +37,7 @@ def batch_tokens(cfg, b=8, s=12, seed=0):
     return jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
 
 
+@needs_partial_manual
 def test_pipeline_forward_matches_plain():
     cfg = pp_cfg()
     params = init_params(cfg, jax.random.key(0))
@@ -45,6 +55,7 @@ def test_pipeline_forward_matches_plain():
                                rtol=1e-5, atol=1e-5)
 
 
+@needs_partial_manual
 def test_pipeline_more_microbatches_than_stages():
     cfg = pp_cfg(pipeline_microbatches=4)
     params = init_params(cfg, jax.random.key(0))
@@ -62,6 +73,7 @@ def test_pipeline_more_microbatches_than_stages():
                                rtol=1e-5, atol=1e-5)
 
 
+@needs_partial_manual
 def test_pipeline_gradients_match_plain():
     cfg = pp_cfg()
     params = init_params(cfg, jax.random.key(0))
@@ -88,6 +100,7 @@ def test_pipeline_gradients_match_plain():
                                    rtol=2e-4, atol=2e-5)
 
 
+@needs_partial_manual
 def test_pipeline_train_step_runs():
     from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
     from runbooks_tpu.train.step import create_train_state, make_train_step
@@ -142,6 +155,7 @@ def loss_weight_grads_ref(cfg, params, tokens, targets, mask=None):
     return loss, grads, total
 
 
+@needs_partial_manual
 def test_1f1b_matches_autodiff_grads():
     """The explicit 1F1B backward must reproduce plain-autodiff loss and
     grads exactly (same math, different schedule) — including with more
@@ -178,6 +192,7 @@ def test_1f1b_matches_autodiff_grads():
                                    rtol=2e-4, atol=2e-5)
 
 
+@needs_partial_manual
 @pytest.mark.slow
 def test_1f1b_train_step_matches_gpipe_step():
     """Full train step through both schedules from identical state: same
@@ -267,6 +282,7 @@ def test_1f1b_activation_memory_bounded_by_stages():
         (f1b_growth, gpipe_growth)
 
 
+@needs_partial_manual
 def test_pipeline_composes_with_ring_attention():
     """SP (ring attention over the sequence axis) inside PP stages: nested
     shard_map (stage manual outside, sequence manual inside) must match the
@@ -293,6 +309,7 @@ def test_pipeline_composes_with_ring_attention():
     dict(tie_embeddings=True),     # tied: head must stay replicated
     dict(vocab_size=65),           # odd: 65 % 2 != 0 -> replicated fallback
 ], ids=["tied", "indivisible-vocab"])
+@needs_partial_manual
 def test_1f1b_replicated_head_path_matches_autodiff(over):
     """The vocab-sharded head only applies to untied, stage-divisible
     vocabularies; these configs must take the replicated-head path and
@@ -322,6 +339,7 @@ def test_1f1b_replicated_head_path_matches_autodiff(over):
                                    rtol=2e-4, atol=2e-5)
 
 
+@needs_partial_manual
 def test_1f1b_bf16_activations_compile_on_cpu():
     """bf16 activations cross the pipeline's psums (y broadcast, dy, dx):
     XLA CPU's AllReducePromotion crashes on bf16 all-reduces, so _psum
@@ -340,6 +358,7 @@ def test_1f1b_bf16_activations_compile_on_cpu():
     assert np.isfinite(float(loss))
 
 
+@needs_partial_manual
 @pytest.mark.slow
 def test_pipeline_composes_with_ring_flash_inner():
     """PP x SP with the FLASH ring inner (the TPU-default composition):
