@@ -118,6 +118,13 @@ run serve-quant-none env RBT_BENCH_QUANTIZE=none python bench_serve.py
 run serve-quant-int8 env RBT_BENCH_QUANTIZE=int8 python bench_serve.py
 run serve-quant-int4 env RBT_BENCH_QUANTIZE=int4 python bench_serve.py
 
+# 5. Fault tolerance (docs/fault-tolerance.md): restart-to-first-step
+#    overhead — restore from the newest intact checkpoint + recompile
+#    (persistent JAX cache warm on accelerator backends). The restart
+#    cost is what preemption tolerance optimizes; compare vs the cold
+#    first step (vs_baseline > 1 = resume beats cold).
+RBT_BENCH_SKIP_SERVE=1 run train-resume env RBT_BENCH_RESUME=1 python bench.py
+
 echo
 echo "Sweep done. Transcripts in bench_logs/; summary appended to ${summary}."
 echo "Commit them: git add bench_logs BENCH_NOTES.md && git commit"

@@ -124,6 +124,23 @@ def on_gce(timeout: float = 1.0, attempts: int = 3) -> bool:
     return False
 
 
+def maintenance_event(timeout: float = 1.0) -> Optional[str]:
+    """The instance's pending maintenance event, or None when nothing is
+    pending (or we are not on GCE / the server is unreachable).
+
+    GCE flips ``instance/maintenance-event`` from NONE to
+    TERMINATE_ON_HOST_MAINTENANCE / MIGRATE_ON_HOST_MAINTENANCE ahead of
+    host maintenance; TPU VMs surface upcoming preemptions the same way.
+    The trainer polls this (train/trainer.py maintenance_poll_s) and treats
+    a pending event like SIGTERM: emergency checkpoint + clean exit, so the
+    work since the last periodic checkpoint survives the event
+    (docs/fault-tolerance.md)."""
+    value = _fetch_raw("instance/maintenance-event", timeout)
+    if value is None or value is _ABSENT or value in ("", "NONE"):
+        return None
+    return str(value)
+
+
 def auto_configure(needed=("project_id", "cluster_name",
                            "cluster_location")) -> dict:
     """Metadata attributes a GKE node exposes that we need for GCPConfig

@@ -217,7 +217,11 @@ def fan_out_job(job: dict, slice_: TPUSlice) -> Optional[dict]:
     spec["completionMode"] = "Indexed"
     pod_spec = spec["template"]["spec"]
     pod_spec["subdomain"] = name
-    # One host dies => whole slice restarts (slice-consistent restart).
+    # One host dies => whole slice restarts (slice-consistent restart):
+    # backoffLimit stays 0 for multi-host — a lost host crashes the peers'
+    # jax.distributed processes too, so per-pod retries cannot reform the
+    # slice; the reconciler recreates the whole Job instead and the
+    # trainer resumes step-exactly (docs/fault-tolerance.md).
     spec["backoffLimit"] = spec.get("backoffLimit", 0)
     pod_spec.setdefault("restartPolicy", "Never")
     env = distributed_env(name, name, namespace, slice_)

@@ -81,10 +81,21 @@ ENUM_PARAMS = {
     **{k: _CM_ENUM for k in _CM_KEYS},
 }
 
+# Preemption-tolerant trainer restarts (docs/fault-tolerance.md): how many
+# preemption-shaped pod failures (trainer EXIT_PREEMPTED after an emergency
+# checkpoint, or SIGTERM's default 143) the train Job absorbs in-place
+# (backoffLimit) before the Job fails. Same spelling set as the other
+# validated trainer knobs.
+_RESTART_KEYS = ("preemption_restarts", "preemptionRestarts",
+                 "preemptionrestarts")
+DEFAULT_PREEMPTION_RESTARTS = 2
+
 # Integer-valued params the trainer int()-coerces at startup: key ->
 # minimum allowed value. A non-integer or out-of-range value would
 # crash-loop the Job at TrainJobConfig.from_params instead of surfacing a
 # condition.
+_MAX_BAD_STEPS_KEYS = ("max_bad_steps", "maxBadSteps", "maxbadsteps")
+
 INT_PARAMS = {
     "loss_chunk": 0,
     "prefetch_depth": 0,
@@ -92,7 +103,32 @@ INT_PARAMS = {
     "seq_len": 1,
     "steps": 1,
     "mesh_stage": 1,
+    # Serving admission-queue bound (serve/api.py); 0 = reject everything
+    # (load-shed), still valid.
+    "max_queue": 0,
+    # Consecutive non-finite steps the trainer tolerates before aborting.
+    **{k: 1 for k in _MAX_BAD_STEPS_KEYS},
+    **{k: 0 for k in _RESTART_KEYS},
 }
+
+# Float-valued params the workloads float()-coerce at startup: key ->
+# minimum allowed value (same crash-loop-vs-condition rationale as
+# INT_PARAMS). All fault-tolerance knobs (docs/fault-tolerance.md).
+FLOAT_PARAMS = {
+    "maintenance_poll_s": 0.0,    # trainer: 0 disables polling
+    "request_timeout_s": 0.0,     # server: default per-request deadline
+    "drain_timeout_s": 0.0,       # server: SIGTERM drain bound
+}
+
+
+def resolve_preemption_restarts(params: dict,
+                                default: int = DEFAULT_PREEMPTION_RESTARTS,
+                                ) -> int:
+    """The preemption-restart budget from a validated spec.params dict."""
+    for key in _RESTART_KEYS:
+        if params.get(key) is not None:
+            return int(params[key])
+    return default
 
 # Keep in sync with TrainJobConfig.batch_size: the divisibility check must
 # hold against the default the trainer will actually use when the spec
@@ -116,6 +152,15 @@ def validate_params(params: dict) -> Optional[str]:
                 return f"spec.params.{key}: {val} must be >= {lo}"
         except (TypeError, ValueError):
             return f"spec.params.{key}: {val!r} is not an integer"
+    for key, flo in FLOAT_PARAMS.items():
+        val = params.get(key)
+        if val is None:
+            continue
+        try:
+            if float(val) < flo:
+                return f"spec.params.{key}: {val} must be >= {flo}"
+        except (TypeError, ValueError):
+            return f"spec.params.{key}: {val!r} is not a number"
     accum = next((params[k] for k in _ACCUM_KEYS
                   if params.get(k) is not None), None)
     if accum is not None:

@@ -29,6 +29,7 @@ from runbooks_tpu.controller.common import (
     reconcile_params_configmap,
     reconcile_service_account,
     resolve_env,
+    resolve_preemption_restarts,
     validate_params,
 )
 from runbooks_tpu.controller.manager import Ctx, Result
@@ -111,10 +112,12 @@ class ModelReconciler:
         failed = any(f for _, f in statuses)
         if failed:
             # Slice-restart-with-resume (SURVEY §7 hard part #1): a TPU
-            # slice Job fails whole (backoffLimit 0, one dead host fails the
-            # slice). Instead of treating that as terminal like the
-            # reference does, recreate the Job — the trainer resumes from
-            # the last orbax checkpoint in the artifact bucket — up to
+            # slice Job fails whole once its in-place budget is spent (the
+            # podFailurePolicy fails application errors immediately and
+            # preemption-shaped exits after backoffLimit retries). Instead
+            # of treating that as terminal like the reference does,
+            # recreate the Job — the trainer resumes step-exactly from the
+            # last intact orbax checkpoint in the artifact bucket — up to
             # resources.tpu.maxRestarts (default 3) attempts.
             if any(ko.deep_get(j, "metadata", "deletionTimestamp")
                    for j in existing_jobs if j is not None):
@@ -231,17 +234,57 @@ class ModelReconciler:
                                 spot=model.spec.get("resources", {})
                                 .get("spot", False))
 
+        single_host_tpu = tpu is not None and not tpu.multi_host
         job = {
             "apiVersion": "batch/v1",
             "kind": "Job",
             "metadata": {"name": job_name, "namespace": model.namespace,
                          "labels": {"model": model.name, "role": "run"}},
             "spec": {
-                # Expensive accelerator jobs do not blind-retry; cheap CPU
-                # import jobs get a few attempts (reference :294-303).
-                "backoffLimit": 0 if tpu is not None else 3,
+                # Expensive accelerator jobs do not blind-retry application
+                # errors; cheap CPU import jobs get a few attempts
+                # (reference :294-303). Single-host TPU jobs absorb
+                # preemption-shaped failures IN PLACE (policy below);
+                # multi-host slices fail whole on any pod failure — a lost
+                # host crashes the peers' jax.distributed processes with
+                # generic exit codes, so per-pod exit-code policy cannot
+                # tell preemption from error there. Their restart-on-
+                # preemption is the reconciler's slice-recreate path
+                # (bounded by resources.tpu.maxRestarts), and resume is
+                # step-exact either way (docs/fault-tolerance.md).
+                "backoffLimit": (
+                    resolve_preemption_restarts(model.params)
+                    if single_host_tpu else 0 if tpu is not None else 3),
                 "template": {"metadata": pod_meta, "spec": pod_spec},
             },
         }
+        if single_host_tpu:
+            # Restart-on-preemption, fail-on-error (docs/fault-tolerance
+            # .md): a preempted node (DisruptionTarget) restarts free of
+            # charge; the trainer's clean preemption exit (EXIT_PREEMPTED,
+            # after its emergency checkpoint — it resumes step-exactly
+            # from the artifact bucket) and a handler-less SIGTERM kill
+            # (143) consume the backoffLimit budget above; any other
+            # non-zero exit is an application error and fails the Job
+            # immediately instead of blind-retrying an expensive slice.
+            from runbooks_tpu.utils.contract import (
+                EXIT_PREEMPTED,
+                EXIT_SIGTERM_DEFAULT,
+            )
+
+            job["spec"]["podFailurePolicy"] = {"rules": [
+                {"action": "Ignore",
+                 "onPodConditions": [{"type": "DisruptionTarget",
+                                      "status": "True"}]},
+                {"action": "Count",
+                 "onExitCodes": {"containerName": "model", "operator": "In",
+                                 "values": [EXIT_PREEMPTED,
+                                            EXIT_SIGTERM_DEFAULT]}},
+                {"action": "FailJob",
+                 "onExitCodes": {"containerName": "model",
+                                 "operator": "NotIn",
+                                 "values": [EXIT_PREEMPTED,
+                                            EXIT_SIGTERM_DEFAULT]}},
+            ]}
         ko.set_owner(job, model.obj)
         return job

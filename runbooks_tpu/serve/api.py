@@ -26,7 +26,12 @@ from typing import Any, Optional, Tuple
 from aiohttp import web
 
 from runbooks_tpu.models.config import ModelConfig, get_config
-from runbooks_tpu.serve.engine import InferenceEngine, Request
+from runbooks_tpu.serve.engine import (
+    EngineDraining,
+    EngineOverloaded,
+    InferenceEngine,
+    Request,
+)
 from runbooks_tpu.train.data import load_tokenizer
 from runbooks_tpu.utils import contract
 
@@ -170,18 +175,39 @@ class EngineWorker:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        self._draining = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def submit(self, req: Request) -> Future:
-        # Validate synchronously so unservable requests raise here (-> 400)
-        # instead of blowing up the worker loop and dooming other requests.
-        self.engine.validate(req)
-        fut: Future = Future()
+        return self.submit_many([req])[0]
+
+    def submit_many(self, reqs: list) -> list:
+        """Admit a batch of requests ATOMICALLY: either every request is
+        accepted or none is (a multi-prompt HTTP body must not leave some
+        prompts decoding with dropped futures after a 429). Validation runs
+        first so unservable requests raise (-> 400) before admission
+        control; a draining server (503) or a full queue (429 +
+        Retry-After) rejects here, before the requests cost anything."""
+        if self._draining:
+            raise EngineDraining(
+                "server is draining (shutdown in progress); "
+                "not accepting new requests")
+        for req in reqs:
+            self.engine.validate(req)
         with self._lock:
-            self._pending.append((req, fut))
+            backlog = len(self.engine.queue) + len(self._pending)
+            if backlog + len(reqs) > self.engine.max_queue:
+                raise EngineOverloaded(
+                    f"admission queue full ({backlog} waiting, bound "
+                    f"{self.engine.max_queue}); retry later")
+            futs = []
+            for req in reqs:
+                fut: Future = Future()
+                self._pending.append((req, fut))
+                futs.append(fut)
         self._wake.set()
-        return fut
+        return futs
 
     def register_prefix(self, tokens: list) -> Future:
         """Register a shared prompt prefix on the worker thread (the
@@ -200,7 +226,15 @@ class EngineWorker:
                 with self._lock:
                     prefix_jobs, self._prefix_jobs = self._prefix_jobs, []
                     for req, fut in self._pending:
-                        self.engine.submit(req)
+                        try:
+                            self.engine.submit(req)
+                        except EngineOverloaded as exc:
+                            # Race between the synchronous admission check
+                            # and this enqueue: reject this request only,
+                            # don't let it reach the crash catch-all.
+                            if not fut.done():
+                                fut.set_exception(exc)
+                            continue
                         self._inflight.append((req, fut))
                     self._pending.clear()
                 for tokens, fut in prefix_jobs:
@@ -314,6 +348,21 @@ class EngineWorker:
         if not self._prefix_warm_queue:
             self._prefix_warm_buffers = None  # free the throwaway pool
 
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain (SIGTERM path): stop admitting (submit raises
+        EngineDraining -> HTTP 503) and wait for every in-flight and
+        already-queued request to finish, bounded by timeout_s. Returns
+        True when fully drained. Call stop() afterwards."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = bool(self._pending or self._inflight)
+            if not busy and not self.engine.has_work():
+                return True
+            time.sleep(0.02)
+        return False
+
     def stop(self) -> None:
         self._stop = True
         self._wake.set()
@@ -336,13 +385,27 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   auto_prefix_chat: bool = False,
                   prefill_budget: Optional[int] = None,
                   decode_chunk: Optional[int] = None,
-                  prefix_cache_size: Optional[int] = None) -> web.Application:
+                  prefix_cache_size: Optional[int] = None,
+                  max_queue: Optional[int] = None,
+                  request_timeout_s: Optional[float] = None,
+                  drain_timeout_s: float = 30.0) -> web.Application:
+    """max_queue bounds the admission queue (full -> HTTP 429 with
+    Retry-After); request_timeout_s is the default per-request wall-clock
+    deadline (body field "timeout" overrides per request; expiry finishes
+    the request with finish_reason "deadline"; 0/None = no default
+    deadline); drain_timeout_s bounds the SIGTERM graceful drain
+    (docs/fault-tolerance.md)."""
+    if not request_timeout_s:
+        # 0 disables, like the other *_s knobs — a validated config of 0
+        # must mean "no deadline", not "400 every deadline-less request".
+        request_timeout_s = None
     tokenizer = tokenizer or load_tokenizer(None)
     engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
                              max_seq_len=max_seq_len, mesh=mesh,
                              prefill_budget=prefill_budget,
                              decode_chunk=decode_chunk,
-                             prefix_cache_size=prefix_cache_size)
+                             prefix_cache_size=prefix_cache_size,
+                             max_queue=max_queue)
     if warmup:
         # Pre-compile all buckets before readiness flips. warm_prefix
         # (params.json: warm_prefix) additionally compiles the prefix-KV
@@ -358,8 +421,22 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     app["model_name"] = cfg.name
     app["requests_total"] = 0
     app["requests_failed_total"] = 0
+    app["requests_rejected_total"] = 0
     app["tokens_total"] = 0
     started = time.time()
+
+    def _reject(app_, exc: EngineOverloaded, n: int = 1) -> web.Response:
+        """Typed backpressure -> HTTP: draining = 503 (terminal for this
+        process), overloaded = 429 + Retry-After (client should back
+        off and retry against a healthy replica)."""
+        app_["requests_rejected_total"] += n
+        if isinstance(exc, EngineDraining):
+            return web.json_response(
+                {"error": {"message": str(exc), "type": "draining"}},
+                status=503, headers={"Retry-After": "5"})
+        return web.json_response(
+            {"error": {"message": str(exc), "type": "overloaded"}},
+            status=429, headers={"Retry-After": "1"})
 
     async def root(request: web.Request) -> web.Response:
         # Readiness probe target (reference probes GET / on the serve port).
@@ -378,6 +455,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             f"serve_decode_steps_total {eng.steps}",
             f"serve_active_slots {int(eng.active.sum())}",
             f"serve_queue_depth {len(eng.queue)}",
+            f"serve_queue_limit {eng.max_queue}",
+            f"serve_requests_rejected_total {app['requests_rejected_total']}",
+            f"serve_deadline_expired_total {eng.deadline_expired}",
+            f"serve_draining {int(worker._draining)}",
             f"serve_prefix_tokens_reused_total {eng.prefix_tokens_reused}",
         ]
         return web.Response(text="\n".join(lines) + "\n",
@@ -409,6 +490,11 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             temperature = float(body.get("temperature", 1.0))
             top_p = float(body.get("top_p", 1.0))
             top_k = int(body.get("top_k", 0))
+            # Per-request wall-clock deadline (seconds); the server-level
+            # request_timeout_s is the default. Enforced between decode
+            # chunks: expiry finishes with finish_reason "deadline".
+            deadline = (float(body["timeout"]) if body.get("timeout")
+                        is not None else request_timeout_s)
         except (TypeError, ValueError):
             return None, web.json_response(
                 {"error": {"message": "malformed sampling parameters"}},
@@ -416,6 +502,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         if max_tokens < 1:
             return None, web.json_response(
                 {"error": {"message": "max_tokens must be >= 1"}},
+                status=400)
+        if deadline is not None and deadline <= 0:
+            return None, web.json_response(
+                {"error": {"message": "timeout must be > 0 seconds"}},
                 status=400)
 
         tok = app_["tokenizer"]
@@ -425,7 +515,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             reqs.append(Request(
                 prompt_tokens=_encode(tok, p), max_tokens=max_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos))
+                eos_id=eos, deadline_s=deadline))
         return reqs, None
 
     async def _stream(app_, body, reqs, http_request,
@@ -448,7 +538,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         worker = app_["worker"]
         app_["requests_total"] += len(reqs)
         try:
-            futs = [asyncio.wrap_future(worker.submit(r)) for r in reqs]
+            futs = [asyncio.wrap_future(f)
+                    for f in worker.submit_many(reqs)]
+        except EngineOverloaded as exc:  # draining (503) / queue full (429)
+            return _reject(app_, exc, len(reqs))
         except ValueError as exc:
             app_["requests_failed_total"] += len(reqs)
             return web.json_response(
@@ -563,7 +656,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         worker = app_["worker"]
         app_["requests_total"] += len(reqs)
         try:
-            futs = [asyncio.wrap_future(worker.submit(r)) for r in reqs]
+            futs = [asyncio.wrap_future(f)
+                    for f in worker.submit_many(reqs)]
+        except EngineOverloaded as exc:  # draining (503) / queue full (429)
+            return _reject(app_, exc, len(reqs))
         except ValueError as exc:  # e.g. prompt exceeds the context window
             app_["requests_failed_total"] += len(reqs)
             return web.json_response(
@@ -575,6 +671,16 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             app_["requests_failed_total"] += len(reqs)
             return web.json_response(
                 {"error": {"message": "generation timed out"}}, status=504)
+        except EngineOverloaded as exc:
+            # Should be unreachable: submit_many's lock-held backlog check
+            # maintains len(queue)+len(pending) <= max_queue, so the
+            # worker-side enqueue cannot overflow. Defense-in-depth only:
+            # retrieve sibling futures so asyncio doesn't log
+            # "exception was never retrieved" for admitted prompts.
+            for f in futs:
+                f.add_done_callback(lambda fut: fut.cancelled()
+                                    or fut.exception())
+            return _reject(app_, exc, len(reqs))
         except ValueError as exc:
             app_["requests_failed_total"] += len(reqs)
             return web.json_response(
@@ -700,6 +806,16 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     app.router.add_post("/v1/prefix", register_prefix)
 
     async def on_cleanup(app):
+        # Graceful drain (SIGTERM path): stop admitting, let in-flight
+        # slots finish, then stop the worker thread. Run off the event
+        # loop so SSE streams can keep flushing while we wait.
+        print("serve: draining (no new admissions; finishing in-flight "
+              "requests)", flush=True)
+        drained = await asyncio.get_running_loop().run_in_executor(
+            None, worker.drain, drain_timeout_s)
+        if not drained:
+            print(f"serve: drain timed out after {drain_timeout_s}s; "
+                  "abandoning remaining requests", flush=True)
         worker.stop()
 
     app.on_cleanup.append(on_cleanup)
@@ -745,8 +861,21 @@ def main() -> int:
                            else None),
         prefill_budget=(int(params["prefill_budget"])
                         if params.get("prefill_budget") is not None
-                        else None))
+                        else None),
+        max_queue=(int(params["max_queue"])
+                   if params.get("max_queue") is not None else None),
+        request_timeout_s=(float(params["request_timeout_s"])
+                           if params.get("request_timeout_s") is not None
+                           else None),
+        drain_timeout_s=float(params.get("drain_timeout_s", 30.0)))
     port = int(params.get("port", contract.SERVE_PORT))
+
+    # Graceful drain on SIGTERM (docs/fault-tolerance.md): run_app's
+    # default handle_signals=True registers SIGTERM/SIGINT to raise
+    # GracefulExit, which tears the site down and runs on_cleanup — our
+    # cleanup drains the engine worker (stop admitting, finish in-flight)
+    # before the process exits 0. No custom handler needed; installing one
+    # here would just be overwritten when run_app sets up its loop.
     web.run_app(app, port=port, print=lambda *a: None)
     return 0
 

@@ -53,6 +53,18 @@ from runbooks_tpu.ops.sampling import sample
 Params = Any
 
 
+class EngineOverloaded(RuntimeError):
+    """Typed admission rejection: the bounded queue is full. Backpressure
+    instead of unbounded queue growth — serve/api.py maps this to HTTP 429
+    with a Retry-After header so well-behaved clients back off
+    (docs/fault-tolerance.md)."""
+
+
+class EngineDraining(EngineOverloaded):
+    """The server is draining (SIGTERM): no new admissions; in-flight
+    requests finish before exit. Maps to HTTP 503."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request (engine-internal)."""
@@ -67,6 +79,11 @@ class Request:
     # next turn's prompt extends this one). Consumed by the serving
     # worker; no effect inside the engine itself.
     auto_prefix: bool = False
+    # Wall-clock budget in seconds from submit(). Enforced between decode
+    # chunks (a chunk in flight is never interrupted): an expired request
+    # finishes with finish_reason "deadline" and whatever tokens it has —
+    # queued requests that expire before admission finish empty-handed.
+    deadline_s: Optional[float] = None
     # Filled by the engine:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
@@ -76,6 +93,7 @@ class Request:
     # — it runs inside the decode loop (SSE uses call_soon_threadsafe).
     on_token: Optional[Callable[[int], None]] = None
     _slot: int = -1
+    _submitted: float = 0.0   # monotonic submit time (deadline anchor)
 
 
 def _buckets(max_prefill: int) -> List[int]:
@@ -97,7 +115,8 @@ class InferenceEngine:
                  prefill_budget: Optional[int] = None,
                  decode_chunk: Optional[int] = None,
                  prefix_cache_size: Optional[int] = None,
-                 quantize_kv: Optional[bool] = None):
+                 quantize_kv: Optional[bool] = None,
+                 max_queue: Optional[int] = None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
@@ -132,7 +151,14 @@ class InferenceEngine:
         Pairs with weight-only quantized params (ops/quantization.py) for
         the reference's 4-bit serving tier. None = follow the config: any
         quantized-weight tier (cfg.quantize != "none") also quantizes the
-        cache unless cfg.quantize_kv forces otherwise."""
+        cache unless cfg.quantize_kv forces otherwise.
+
+        max_queue: bound on the admission queue (waiting requests, not
+        in-flight slots). submit() past the bound raises the typed
+        EngineOverloaded instead of growing the list without limit — at
+        overload, every queued request's deadline/latency degrades
+        together, so shedding with a 429 beats accepting work the engine
+        cannot serve in time. Default: max(16, 4 * max_slots)."""
         self.cfg = cfg
         self.mesh = mesh
         self.prefill_budget = prefill_budget
@@ -187,6 +213,9 @@ class InferenceEngine:
         self._pad_slot = self.max_seq_len  # trash slot index
         if self.prefill_budget is None:
             self.prefill_budget = self.max_seq_len
+        self.max_queue = (max_queue if max_queue is not None
+                          else max(16, 4 * max_slots))
+        self.deadline_expired = 0   # observability/tests
         self.lengths = np.zeros(max_slots, np.int32)       # tokens in cache
         self.active = np.zeros(max_slots, bool)
         self.last_token = np.zeros(max_slots, np.int32)
@@ -631,6 +660,11 @@ class InferenceEngine:
 
     def submit(self, req: Request) -> None:
         self.validate(req)
+        if len(self.queue) >= self.max_queue:
+            raise EngineOverloaded(
+                f"admission queue full ({len(self.queue)} waiting, "
+                f"bound {self.max_queue}); retry later")
+        req._submitted = time.monotonic()
         self.queue.append(req)
 
     def reset(self) -> None:
@@ -646,8 +680,9 @@ class InferenceEngine:
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any())
 
-    def _free_slots(self) -> List[int]:
-        return [i for i in range(self.max_slots) if not self.active[i]]
+    def _free_slots(self, exclude=()) -> List[int]:
+        return [i for i in range(self.max_slots)
+                if not self.active[i] and i not in exclude]
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -655,10 +690,10 @@ class InferenceEngine:
                 return b
         return self.prefill_buckets[-1]
 
-    def _admit(self) -> None:
+    def _admit(self, exclude_slots=()) -> None:
         budget = self.prefill_budget
         admitted: List[tuple] = []
-        for slot in self._free_slots():
+        for slot in self._free_slots(exclude_slots):
             if not self.queue:
                 break
             # Budget in bucket-padded tokens (what the prefill actually
@@ -771,12 +806,51 @@ class InferenceEngine:
             self.active[slot] = False
             self.slot_req[slot] = None
 
+    def _expire_deadlines(self) -> List[int]:
+        """Finish requests whose wall-clock deadline passed (between decode
+        chunks — a dispatched chunk is never interrupted). Queued requests
+        expire empty-handed before ever occupying a slot; active requests
+        free their slot with the tokens they have (finish_reason
+        "deadline" either way). Returns the slots freed by expiry — the
+        same step's _admit must NOT reuse them, so the worker's post-step
+        finished-request pass (e.g. auto-prefix registration from the
+        slot) still sees the expired request's KV, not a new tenant's."""
+        now = time.monotonic()
+
+        def expired(r: Request) -> bool:
+            return (r.deadline_s is not None
+                    and now >= r._submitted + r.deadline_s)
+
+        n = 0
+        keep = []
+        for r in self.queue:
+            if expired(r):
+                r.finished = True
+                r.finish_reason = "deadline"
+                n += 1
+            else:
+                keep.append(r)
+        if n:
+            self.queue[:] = keep
+        freed: List[int] = []
+        for slot in range(self.max_slots):
+            req = self.slot_req[slot]
+            if self.active[slot] and req is not None and expired(req):
+                req.finished = True
+                req.finish_reason = "deadline"
+                self.active[slot] = False
+                self.slot_req[slot] = None
+                freed.append(slot)
+                n += 1
+        self.deadline_expired += n
+        return freed
+
     def step(self) -> int:
         """Admit queued requests, run one decode chunk (`decode_chunk`
         forward steps in a single jit call). Returns the number of tokens
         generated across slots (== active-slot count when chunk=1 and
         nothing finishes mid-chunk)."""
-        self._admit()
+        self._admit(exclude_slots=self._expire_deadlines())
         if not self.active.any():
             return 0
         # Inactive rows decode into the trash slot at a harmless position;

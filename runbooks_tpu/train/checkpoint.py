@@ -9,12 +9,21 @@ while the previous step uploads), resume = restore latest.
 
 Sharding-aware: restore takes the target TrainState shardings, so a
 checkpoint written on one mesh layout restores onto another (orbax reshards).
+
+Fault-tolerant (docs/fault-tolerance.md): every completed save is stamped
+with an integrity marker (``rbt-intact.json``) that also carries the data-
+pipeline cursor, so a preemption mid-async-save leaves a step directory
+restore can *recognize* as partial and skip — ``restore`` walks backward to
+the newest intact checkpoint instead of dying on the corrupt latest. The
+cursor payload is plain JSON next to the arrays: it survives restoring onto
+a different mesh untouched (orbax only reshards the arrays).
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
@@ -22,6 +31,12 @@ import orbax.checkpoint as ocp
 
 class CheckpointManager:
     """Thin orbax wrapper bound to an artifact directory."""
+
+    # Written inside a step directory once its (possibly async) save has
+    # fully landed; absence marks the directory as partial (preemption or
+    # crash mid-save). Lives inside the step dir so orbax's max_to_keep
+    # garbage collection removes it together with the arrays.
+    MARKER = "rbt-intact.json"
 
     def __init__(self, artifacts_dir: str, max_to_keep: int = 3,
                  async_save: bool = True):
@@ -35,30 +50,141 @@ class CheckpointManager:
                 enable_async_checkpointing=async_save,
             ),
         )
+        # step -> cursor dict for saves whose marker is not yet written
+        # (async saves finalize on the next save()/wait()).
+        self._pending: Dict[int, dict] = {}
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
-        return self._mgr.save(
+    # -- integrity markers + cursor ------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def _marker_path(self, step: int) -> str:
+        return os.path.join(self._step_dir(step), self.MARKER)
+
+    def _finalize_pending(self) -> None:
+        """Stamp the marker for every landed save (call only after
+        wait_until_finished — a marker on a still-writing dir would defeat
+        its purpose)."""
+        for step, cursor in list(self._pending.items()):
+            step_dir = self._step_dir(step)
+            if os.path.isdir(step_dir):
+                tmp = self._marker_path(step) + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"step": step, "cursor": cursor}, f)
+                os.replace(tmp, self._marker_path(step))
+            self._pending.pop(step, None)
+
+    def intact_steps(self) -> list:
+        """Ascending steps whose save completed (marker present)."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return steps
+        for name in names:
+            if name.isdigit() and os.path.exists(self._marker_path(int(name))):
+                steps.append(int(name))
+        return sorted(steps)
+
+    def read_cursor(self, step: int) -> dict:
+        """Data-pipeline cursor saved alongside ``step`` ({} when absent or
+        unreadable — legacy checkpoints predate the marker)."""
+        try:
+            with open(self._marker_path(step)) as f:
+                return dict(json.load(f).get("cursor") or {})
+        except (OSError, ValueError):
+            return {}
+
+    # -- save/restore ---------------------------------------------------
+
+    def save(self, step: int, state: Any, force: bool = False,
+             cursor: Optional[dict] = None) -> bool:
+        """Save ``state`` at ``step``; ``cursor`` (a small JSON-able dict,
+        e.g. {"batches_consumed": n}) is stamped into the integrity marker
+        once the save lands, so resume can continue the data stream
+        step-exactly instead of replaying it from the start."""
+        # Let any in-flight async save land and stamp its marker before
+        # starting the next one (orbax serializes the saves regardless).
+        self._mgr.wait_until_finished()
+        self._finalize_pending()
+        saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force)
+        if saved:
+            self._pending[int(step)] = dict(cursor or {})
+        return saved
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def latest_intact_step(self) -> Optional[int]:
+        """Newest step whose save completed; falls back to orbax's latest
+        for pre-marker (legacy) checkpoint directories."""
+        steps = self.intact_steps()
+        if steps:
+            return steps[-1]
+        return self._mgr.latest_step()
+
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of ``state_like`` (a TrainState
-        of jax.ShapeDtypeStruct with .sharding set, or a concrete state)."""
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        of jax.ShapeDtypeStruct with .sharding set, or a concrete state).
+
+        With step=None, restores the newest *intact* checkpoint and falls
+        back to older ones when the latest is partial or corrupt (e.g. a
+        preemption mid-async-save truncated it)."""
+        return self.restore_with_cursor(state_like, step)[0]
+
+    def restore_with_cursor(self, state_like: Any,
+                            step: Optional[int] = None,
+                            ) -> Tuple[Any, dict, int]:
+        """Like ``restore`` but returns (state, cursor, restored_step) so
+        the trainer can resume its data pipeline at the exact batch the
+        checkpointed step had consumed."""
         def as_abstract(x):
             if isinstance(x, jax.Array):
-                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=x.sharding)
             return x
         abstract = jax.tree.map(as_abstract, state_like)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        if step is not None:
+            state = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+            return state, self.read_cursor(step), int(step)
+
+        all_steps = sorted(int(s) for s in self._mgr.all_steps())
+        if not all_steps:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        marked = set(self.intact_steps())
+        # Prefer intact checkpoints, newest first; when nothing is marked
+        # (legacy layout) try everything newest-first anyway.
+        candidates = sorted((s for s in all_steps if s in marked),
+                            reverse=True) or sorted(all_steps, reverse=True)
+        skipped = [s for s in all_steps if s > candidates[0]]
+        if skipped:
+            print(f"checkpoint: ignoring partial step dir(s) {skipped} "
+                  "(no integrity marker — interrupted save); restoring "
+                  f"step {candidates[0]}", flush=True)
+        last_exc: Optional[Exception] = None
+        for s in candidates:
+            try:
+                state = self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(abstract))
+            except Exception as exc:  # noqa: BLE001 — corrupt/partial step
+                print(f"checkpoint: step {s} failed to restore ({exc!r}); "
+                      "falling back to the previous checkpoint", flush=True)
+                last_exc = exc
+                continue
+            return state, self.read_cursor(s), s
+        raise RuntimeError(
+            f"no checkpoint under {self.directory} could be restored "
+            f"(tried {candidates})") from last_exc
 
     def wait(self) -> None:
-        """Block until in-flight async saves land (call before exit)."""
+        """Block until in-flight async saves land (call before exit), then
+        stamp their integrity markers."""
         self._mgr.wait_until_finished()
+        self._finalize_pending()
 
     def close(self) -> None:
         self._mgr.close()
+        self._finalize_pending()

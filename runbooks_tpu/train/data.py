@@ -218,6 +218,23 @@ def dataset(
         epoch += 1
 
 
+def skip_batches(it: Iterator[Batch], n: int) -> Iterator[Batch]:
+    """Advance ``it`` past its first ``n`` batches — the resume half of the
+    checkpoint data cursor (docs/fault-tolerance.md): a run restored at a
+    checkpoint that had consumed n batches must see batch n first, exactly
+    as the uninterrupted run would, instead of replaying the dataset from
+    document 0. Draining re-runs tokenize/pack on the host (deterministic,
+    no device work); batches a prefetcher had in flight beyond the cursor
+    at preemption time are simply regenerated."""
+    it = iter(it)
+    for _ in range(n):
+        try:
+            next(it)
+        except StopIteration:  # finite dataset shorter than the cursor
+            break
+    return it
+
+
 class Prefetcher:
     """Bounded background-thread prefetcher: overlap host-side batch
     production (tokenize/pack — everything upstream in the iterator) and,

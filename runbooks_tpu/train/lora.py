@@ -190,8 +190,17 @@ def make_lora_train_step(model_cfg, lora_cfg: LoraConfig, optimizer, mesh,
                 loss_fn, has_aux=True)(state.params)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_lora = optax.apply_updates(state.params, updates)
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
-                   "weight_tokens": total}
+        grad_norm = optax.global_norm(grads)
+        # Non-finite guard, same contract as make_train_step: a bad batch
+        # skips the update (LoRA params + opt state bitwise unchanged) and
+        # flags the step for the trainer's consecutive-bad-step abort.
+        ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+        new_lora, new_opt = jax.tree.map(
+            lambda new, old: jnp.where(ok, new, old),
+            (new_lora, new_opt), (state.params, state.opt_state))
+        metrics = {"loss": loss, "grad_norm": grad_norm,
+                   "weight_tokens": total,
+                   "nonfinite": (~ok).astype(jnp.int32)}
         return TrainState(step=state.step + 1, params=new_lora,
                           opt_state=new_opt), metrics
 

@@ -356,10 +356,21 @@ def make_train_step(
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        grad_norm = optax.global_norm(grads)
+        # Non-finite guard (docs/fault-tolerance.md): a poisoned batch or a
+        # numeric blow-up must not write NaN into the params — the update is
+        # skipped wholesale (params AND optimizer state bitwise unchanged,
+        # step counter still advances) and the step is flagged in metrics so
+        # the trainer can count consecutive bad steps and abort.
+        ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+        new_params, new_opt_state = jax.tree.map(
+            lambda new, old: jnp.where(ok, new, old),
+            (new_params, new_opt_state), (state.params, state.opt_state))
         metrics = {
             "loss": loss,
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
             "weight_tokens": total_weight,
+            "nonfinite": (~ok).astype(jnp.int32),
         }
         return TrainState(step=state.step + 1, params=new_params,
                           opt_state=new_opt_state), metrics

@@ -15,6 +15,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import signal
+import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
@@ -33,6 +36,40 @@ from runbooks_tpu.train.lora import (
 from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
 from runbooks_tpu.train.step import create_train_state, make_train_step
 from runbooks_tpu.utils import contract
+from runbooks_tpu.utils.contract import EXIT_PREEMPTED
+
+
+class SimulatedFault(RuntimeError):
+    """Raised by the RBT_FAULT_INJECT hook's `kill` mode: a deterministic
+    stand-in for an abrupt process death (no emergency checkpoint, no
+    cleanup beyond `finally`), used by tests/test_fault_tolerance.py to
+    prove step-exact resume."""
+
+
+def _parse_fault_inject() -> Optional[dict]:
+    """RBT_FAULT_INJECT=<mode>:<step>[+] — the deterministic fault-injection
+    hook (docs/fault-tolerance.md). Modes:
+
+      kill:K       raise SimulatedFault at the top of step K (the run dies
+                   as a preemption would, mid-stream, without the graceful
+                   paths)
+      sigterm:K    deliver SIGTERM to this process at the top of step K
+                   (exercises the real handler: emergency checkpoint +
+                   preempted exit)
+      nonfinite:K  poison step K's batch with NaN (exercises the non-finite
+                   guard); `K+` poisons every step from K on (exercises the
+                   consecutive-bad-step abort)
+    """
+    spec = os.environ.get("RBT_FAULT_INJECT", "")
+    if not spec:
+        return None
+    mode, _, step = spec.partition(":")
+    if mode not in ("kill", "sigterm", "nonfinite") or not step:
+        raise ValueError(
+            f"RBT_FAULT_INJECT={spec!r}: expected kill:K|sigterm:K|"
+            "nonfinite:K[+]")
+    return {"mode": mode, "step": int(step.rstrip("+")),
+            "repeat": step.endswith("+")}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +110,15 @@ class TrainJobConfig:
     artifacts_dir: Optional[str] = None   # default: contract artifacts dir
     log_every: int = 10
     resume: bool = True
+    # Fault tolerance (docs/fault-tolerance.md): abort after this many
+    # CONSECUTIVE non-finite loss/grad steps (each bad step skips the
+    # update — params bitwise unchanged — so a transient bad batch costs
+    # one step, not the run). maintenance_poll_s > 0 polls the GCE
+    # metadata server for a pending maintenance event/preemption and
+    # treats one like SIGTERM (emergency checkpoint + clean exit);
+    # main() turns it on automatically when running on GCE.
+    max_bad_steps: int = 3
+    maintenance_poll_s: float = 0.0
     # XLA/JAX profiler capture: trace steps [profile_start, profile_stop)
     # into {artifacts}/profile (viewable in XProf/TensorBoard). Net-new vs
     # the reference, which has no profiling hooks (SURVEY.md §5.1).
@@ -92,6 +138,9 @@ class TrainJobConfig:
         for alias in ("accumulateSteps", "accumulatesteps"):
             if alias in params:
                 params.setdefault("accumulate_steps", params.pop(alias))
+        for alias in ("maxBadSteps", "maxbadsteps"):
+            if alias in params:
+                params.setdefault("max_bad_steps", params.pop(alias))
         from runbooks_tpu.models.config import COLLECTIVE_MATMUL_PARAM_KEYS
 
         for alias in COLLECTIVE_MATMUL_PARAM_KEYS[1:]:
@@ -106,9 +155,11 @@ class TrainJobConfig:
         # YAML specs quote freely ("8"); a str here would TypeError deep in
         # run_training instead of at the validated boundary.
         for key in ("accumulate_steps", "loss_chunk", "prefetch_depth",
-                    "batch_size", "seq_len", "steps"):
+                    "batch_size", "seq_len", "steps", "max_bad_steps"):
             if key in kwargs:
                 kwargs[key] = int(kwargs[key])
+        if "maintenance_poll_s" in kwargs:
+            kwargs["maintenance_poll_s"] = float(kwargs["maintenance_poll_s"])
         mesh_keys = {f.name for f in dataclasses.fields(MeshConfig)}
         mesh_args = {k[len("mesh_"):]: int(v) for k, v in params.items()
                      if k.startswith("mesh_") and k[len("mesh_"):] in mesh_keys}
@@ -127,9 +178,9 @@ class TrainJobConfig:
         return cls(**kwargs)
 
 
-def _batches(job: TrainJobConfig, model_cfg: ModelConfig) -> Iterator[dict]:
+def _batches(job: TrainJobConfig, model_cfg: ModelConfig,
+             skip: int = 0) -> Iterator[dict]:
     path = job.data_path or contract.data_dir()
-    import os
 
     if path and os.path.exists(path):
         tok = data_mod.load_tokenizer(job.tokenizer)
@@ -141,20 +192,33 @@ def _batches(job: TrainJobConfig, model_cfg: ModelConfig) -> Iterator[dict]:
             raise ValueError(
                 f"tokenizer vocab {vocab} exceeds model vocab "
                 f"{model_cfg.vocab_size}")
-        return data_mod.dataset(path, job.seq_len, job.batch_size,
-                                tokenizer=tok, epochs=None,
-                                text_key=job.text_key,
-                                prompt_template=job.prompt_template)
-    return data_mod.synthetic_batches(model_cfg.vocab_size, job.seq_len,
-                                      job.batch_size, job.seed)
+        it = data_mod.dataset(path, job.seq_len, job.batch_size,
+                              tokenizer=tok, epochs=None,
+                              text_key=job.text_key,
+                              prompt_template=job.prompt_template)
+    else:
+        it = data_mod.synthetic_batches(model_cfg.vocab_size, job.seq_len,
+                                        job.batch_size, job.seed)
+    if skip:
+        # Resume at the checkpoint's data cursor: batch `skip` comes first,
+        # exactly as the uninterrupted run would have seen it.
+        print(f"data: advancing to batch cursor {skip} "
+              "(step-exact resume)", flush=True)
+        it = data_mod.skip_batches(it, skip)
+    return it
 
 
 def run_training(job: TrainJobConfig,
                  base_params=None) -> Dict[str, Any]:
     """Run the training job; returns final metrics summary (also written to
-    {artifacts}/metrics.json)."""
-    import os
+    {artifacts}/metrics.json).
 
+    Preemption-tolerant (docs/fault-tolerance.md): SIGTERM/SIGINT (and a
+    pending GCE maintenance event, when polled) stop the loop at the next
+    step boundary, force an emergency checkpoint carrying the data cursor,
+    and return with summary["exit_reason"] set — main() maps that to the
+    documented EXIT_PREEMPTED code so the controller's Job policy restarts
+    the pod instead of failing the run."""
     model_cfg = get_config(job.model, **job.model_overrides)
     if job.collective_matmul is not None:
         # Fail at the validated boundary, not mid-compile: the
@@ -217,47 +281,150 @@ def run_training(job: TrainJobConfig,
                                   accumulate_steps=job.accumulate_steps,
                                   loss_chunk=job.loss_chunk)
 
-    start_step = 0
-    if job.resume and ckpt.latest_step() is not None:
-        state = ckpt.restore(state)
-        start_step = int(state.step)
+    # May raise on a malformed value — before any state needing cleanup.
+    fault = _parse_fault_inject()
 
-    batches = _batches(job, model_cfg)
+    start_step = 0
+    consumed = 0          # batches pulled from the data stream (the cursor)
+    restore_time_s = None
+    stop = {"reason": None}
+    restore_sigs = []
+    poller_stop = None
     prefetcher = None
-    if job.prefetch_depth > 0:
-        # Async input pipeline: tokenize/pack runs ahead on a background
-        # thread and batches land on device (sharded device_put) while the
-        # previous step computes — host work overlaps device compute
-        # instead of serializing with it inside the step loop.
-        batches = prefetcher = data_mod.Prefetcher(
-            batches, depth=job.prefetch_depth,
-            place=data_mod.device_placer(mesh))
     history = []
     tokens_per_step = job.batch_size * job.seq_len
     flops_per_token = 3.0 * model_cfg.flops_per_token(job.seq_len)
     from runbooks_tpu.utils.hw import chip_peak_flops
 
     peak_flops = chip_peak_flops(jax.devices()[0]) * len(jax.devices())
-    t_start = time.perf_counter()
     tokens_done = 0
     compile_time_s = None
 
     profiling = False
+    exit_reason = None
+    bad_streak = 0
+    nonfinite_steps = 0
+    pending_nf = None      # previous step's (index, nonfinite flag)
+    last_saved = -1
+
+    def _check_nonfinite(pending) -> None:
+        # Checked one step LATE on purpose: pulling the flag then only
+        # waits on an already-finished step, so the guard adds no host/
+        # device sync to the steady-state pipeline.
+        nonlocal bad_streak, nonfinite_steps
+        if pending is None:
+            return
+        step_idx, nf = pending
+        if nf is None or float(nf) == 0.0:
+            bad_streak = 0
+            return
+        bad_streak += 1
+        nonfinite_steps += 1
+        print(json.dumps({"step": step_idx + 1, "nonfinite": True,
+                          "consecutive_bad": bad_streak}), flush=True)
+        if bad_streak >= max(1, job.max_bad_steps):
+            raise RuntimeError(
+                f"aborting: {bad_streak} consecutive non-finite loss/grad "
+                f"steps (last at step {step_idx + 1}). Params were left "
+                "unchanged by every bad step — inspect the data shard / "
+                "learning rate and resume from the last checkpoint "
+                "(docs/fault-tolerance.md)")
+
+    def _fault_due(i: int, mode: str) -> bool:
+        return (fault is not None and fault["mode"] == mode
+                and (i == fault["step"]
+                     or (fault["repeat"] and i >= fault["step"])))
+
+    # Everything from here runs under the cleanup block: a failure in
+    # restore, data-pipeline setup, or the loop itself must still restore
+    # the signal handlers and wait/close the async checkpoint manager.
     try:
+        if job.resume and ckpt.latest_intact_step() is not None:
+            t_restore = time.perf_counter()
+            state, cursor, _ckpt_step = ckpt.restore_with_cursor(state)
+            restore_time_s = time.perf_counter() - t_restore
+            start_step = int(state.step)
+            last_saved = start_step
+            # Legacy (pre-cursor) checkpoints: every step consumes exactly
+            # one batch from a stream that starts at 0, so the step count
+            # is the correct cursor for any run this trainer produced.
+            consumed = int(cursor.get("batches_consumed", start_step))
+
+        # Preemption handling: SIGTERM/SIGINT (and a pending GCE
+        # maintenance event, when polling is on) set the stop reason; the
+        # loop notices at the next step boundary and takes the
+        # emergency-checkpoint path.
+        if threading.current_thread() is threading.main_thread():
+            def _on_signal(signum, frame):
+                name = signal.Signals(signum).name
+                if stop["reason"] is None:
+                    stop["reason"] = ("sigint" if signum == signal.SIGINT
+                                      else "sigterm")
+                    print(f"trainer: caught {name}; emergency checkpoint "
+                          "at the next step boundary", flush=True)
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                restore_sigs.append((sig, signal.signal(sig, _on_signal)))
+        if job.maintenance_poll_s > 0:
+            poller_stop = threading.Event()
+            poller_wait = poller_stop
+
+            def _poll_maintenance():
+                from runbooks_tpu.cloud import metadata
+
+                while not poller_wait.wait(job.maintenance_poll_s):
+                    try:
+                        event = metadata.maintenance_event()
+                    except Exception:  # noqa: BLE001 — flake != stop
+                        continue
+                    if event and stop["reason"] is None:
+                        stop["reason"] = "maintenance"
+                        print(f"trainer: GCE maintenance event {event!r}; "
+                              "emergency checkpoint at the next step "
+                              "boundary", flush=True)
+                        return
+
+            threading.Thread(target=_poll_maintenance,
+                             name="rbt-maintenance", daemon=True).start()
+
+        batches = _batches(job, model_cfg, skip=consumed)
+        if job.prefetch_depth > 0:
+            # Async input pipeline: tokenize/pack runs ahead on a
+            # background thread and batches land on device (sharded
+            # device_put) while the previous step computes — host work
+            # overlaps device compute instead of serializing with it
+            # inside the step loop.
+            batches = prefetcher = data_mod.Prefetcher(
+                batches, depth=job.prefetch_depth,
+                place=data_mod.device_placer(mesh))
+        t_start = time.perf_counter()
         with jax.set_mesh(mesh):
             for i in range(start_step, job.steps):
+                if _fault_due(i, "kill"):
+                    raise SimulatedFault(
+                        f"RBT_FAULT_INJECT: simulated death at step {i}")
+                if _fault_due(i, "sigterm"):
+                    os.kill(os.getpid(), signal.SIGTERM)
+                if stop["reason"]:
+                    exit_reason = stop["reason"]
+                    break
                 if job.profile_stop > job.profile_start \
                         and i == job.profile_start:
                     jax.profiler.start_trace(
                         os.path.join(artifacts, "profile"))
                     profiling = True
                 batch = next(batches)
+                consumed += 1
                 if prefetcher is None:
                     batch = {k: np.asarray(v) for k, v in batch.items()}
+                if _fault_due(i, "nonfinite"):
+                    batch = dict(batch)
+                    batch["loss_mask"] = batch["loss_mask"] * float("nan")
                 if lora_mode:
                     state, metrics = step_fn(state, base_params, batch)
                 else:
                     state, metrics = step_fn(state, batch)
+                _check_nonfinite(pending_nf)
+                pending_nf = (i, metrics.get("nonfinite"))
                 if i == start_step:
                     # The first step folds the XLA compile; pulling the
                     # loss waits for it, then the throughput window resets
@@ -293,22 +460,59 @@ def run_training(job: TrainJobConfig,
                     history.append(entry)
                     print(json.dumps(entry), flush=True)
                 if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:
-                    ckpt.save(i + 1, state)
+                    ckpt.save(i + 1, state,
+                              cursor={"batches_consumed": consumed})
+                    last_saved = i + 1
+            if exit_reason is None:
+                _check_nonfinite(pending_nf)
+            else:
+                # Emergency checkpoint: the work since the last periodic
+                # save must survive the preemption. Carries the data
+                # cursor like every save; force=True overwrites a same-step
+                # periodic save if the stop landed right after one.
+                step_now = int(state.step)
+                if step_now != last_saved:
+                    ckpt.save(step_now, state,
+                              cursor={"batches_consumed": consumed},
+                              force=True)
+                print(json.dumps({"preempted": exit_reason,
+                                  "emergency_checkpoint_step": step_now}),
+                      flush=True)
     finally:
         if prefetcher is not None:
             prefetcher.close()
+        if poller_stop is not None:
+            poller_stop.set()
+        # Async-checkpoint cleanup belongs HERE: an exception mid-run must
+        # not leave the orbax save thread dangling with a half-written step
+        # directory (wait() also stamps the integrity markers; close()
+        # releases the manager even if wait itself blows up). Signal
+        # handlers restore only AFTER the saves land — a SIGTERM during
+        # the final wait must not kill the process mid-save (observed: a
+        # kernel-default 143 death leaving an orbax tmp dir).
+        try:
+            try:
+                ckpt.wait()
+            finally:
+                ckpt.close()
+        finally:
+            for sig, old in restore_sigs:
+                signal.signal(sig, old)
 
     if profiling:  # profile window ran past the last step
         jax.profiler.stop_trace()
-    ckpt.wait()
     summary = {
         "final_loss": history[-1]["loss"] if history else None,
         "steps": job.steps,
         "tokens_per_sec": history[-1]["tokens_per_sec"] if history else None,
         "compile_time_s": compile_time_s,
+        "restore_time_s": restore_time_s,
         "accumulate_steps": job.accumulate_steps,
         "model": job.model,
         "lora": lora_mode,
+        "exit_reason": exit_reason,
+        "nonfinite_steps": nonfinite_steps,
+        "batches_consumed": consumed,
         "history": history,
     }
     with open(os.path.join(artifacts, "metrics.json"), "w") as f:
@@ -318,17 +522,35 @@ def run_training(job: TrainJobConfig,
         merged_note = {"note": "merged weights = base + lora; see checkpoints"}
         with open(os.path.join(artifacts, "lora.json"), "w") as f:
             json.dump(dataclasses.asdict(job.lora) | merged_note, f)
-    ckpt.close()
     return summary
+
+
+def exit_code_for(summary: Dict[str, Any]) -> int:
+    """Container exit code for a finished run: EXIT_PREEMPTED (42) when the
+    run stopped for a preemption-shaped reason (SIGTERM/SIGINT/maintenance
+    event, after its emergency checkpoint), 0 otherwise. The controller's
+    train-Job podFailurePolicy restarts on 42 but fails the Job on any
+    other non-zero code (docs/fault-tolerance.md)."""
+    if summary.get("exit_reason") in ("sigterm", "sigint", "maintenance"):
+        return EXIT_PREEMPTED
+    return 0
 
 
 def main() -> int:
     params = contract.load_params()
     job = TrainJobConfig.from_params(params)
+    if job.maintenance_poll_s == 0 and "maintenance_poll_s" not in params:
+        # Container entry point on GCE: watch for maintenance events /
+        # preemptions by default (a quick single-attempt probe — an off-GCE
+        # box must not stall startup on a dead metadata address).
+        from runbooks_tpu.cloud import metadata
+
+        if metadata.on_gce(timeout=0.5, attempts=1):
+            job = dataclasses.replace(job, maintenance_poll_s=5.0)
     summary = run_training(job)
     print(json.dumps({"done": True, **{k: v for k, v in summary.items()
                                        if k != "history"}}))
-    return 0
+    return exit_code_for(summary)
 
 
 if __name__ == "__main__":

@@ -21,6 +21,18 @@ from typing import Any, Dict, Optional
 SERVE_PORT = 8080
 NOTEBOOK_PORT = 8888
 
+# Workload exit codes (docs/fault-tolerance.md). EXIT_PREEMPTED is the
+# trainer's "I was told to stop (SIGTERM/SIGINT/maintenance event) and wrote
+# an emergency checkpoint" exit: the controller's train-Job podFailurePolicy
+# restarts on it (bounded by spec.params.preemption_restarts) but treats any
+# other non-zero exit as an application error and fails the Job immediately.
+# Lives here (not in the trainer module) so the controller can reference it
+# without importing JAX.
+EXIT_PREEMPTED = 42
+# SIGTERM's default disposition (128 + 15): what a trainer that never got to
+# install its handler exits with when the kubelet kills it.
+EXIT_SIGTERM_DEFAULT = 143
+
 
 def content_dir() -> str:
     # Read dynamically so tests/tools can repoint /content via env.

@@ -225,7 +225,11 @@ def test_model_tpu_multihost_fanout(harness):
     spec = job["spec"]
     assert spec["completions"] == 2 and spec["parallelism"] == 2
     assert spec["completionMode"] == "Indexed"
-    assert spec["backoffLimit"] == 0  # expensive TPU job: no blind retry
+    # Multi-host: no in-place pod retries (a lost host crashes the peers
+    # with generic exit codes — exit-code policy can't tell preemption
+    # from error); the reconciler's slice-recreate path handles restarts.
+    assert spec["backoffLimit"] == 0
+    assert "podFailurePolicy" not in spec
     pod = spec["template"]["spec"]
     assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == \
         "tpu-v5-lite-podslice"
@@ -572,4 +576,65 @@ def test_server_invalid_quantize_param_surfaces_condition(harness):
     mgr.reconcile_until_stable()
     c = ko.get_condition(Server(get(client, "Server", "qs")).obj,
                          cond.SERVING)
+    assert c["reason"] != cond.REASON_INVALID_PARAMS
+
+
+def test_model_preemption_restart_policy_knob(harness):
+    """Train Jobs get a restart-on-preemption policy wired to the trainer's
+    exit codes (docs/fault-tolerance.md): spec.params.preemption_restarts
+    sets the in-place budget; the podFailurePolicy restarts on preemption-
+    shaped exits (42/143, and node DisruptionTarget for free) but fails
+    the Job on any other error instead of blind-retrying a TPU slice."""
+    from runbooks_tpu.utils.contract import EXIT_PREEMPTED
+
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("pr", spec={
+        "image": "trainer",
+        "params": {"model": "debug", "preemptionRestarts": 5},
+        "resources": {"tpu": {"type": "v5e", "topology": "2x2"}}}).obj)
+    mgr.reconcile_until_stable()
+    job = client.get("batch/v1", "Job", "default", "pr-modeller")
+    spec = job["spec"]
+    assert spec["backoffLimit"] == 5  # single-host 2x2: no host scaling
+    rules = spec["podFailurePolicy"]["rules"]
+    assert rules[0]["action"] == "Ignore"
+    assert rules[0]["onPodConditions"][0]["type"] == "DisruptionTarget"
+    assert rules[1]["action"] == "Count"
+    assert EXIT_PREEMPTED in rules[1]["onExitCodes"]["values"]
+    assert rules[2]["action"] == "FailJob"
+    assert rules[2]["onExitCodes"]["operator"] == "NotIn"
+    assert EXIT_PREEMPTED in rules[2]["onExitCodes"]["values"]
+
+
+def test_model_invalid_preemption_restarts_surfaces_condition(harness):
+    """A bad spec.params.preemption_restarts value must become an
+    InvalidParams condition, not a crash-looping Job."""
+    client, cloud, sci, mgr = harness
+    client.create(Model.new("prbad", spec={
+        "image": "trainer",
+        "params": {"model": "debug", "preemption_restarts": "lots"}}).obj)
+    mgr.reconcile_until_stable()
+    c = ko.get_condition(Model(get(client, "Model", "prbad")).obj,
+                         cond.COMPLETE)
+    assert c["status"] == "False"
+    assert c["reason"] == cond.REASON_INVALID_PARAMS
+    assert "preemption_restarts" in c["message"]
+
+    cur = Model(get(client, "Model", "prbad"))
+    cur.obj["spec"]["params"] = {"model": "debug",
+                                 "preemption_restarts": -1}
+    client.update(cur.obj)
+    mgr.reconcile_until_stable()
+    c = ko.get_condition(Model(get(client, "Model", "prbad")).obj,
+                         cond.COMPLETE)
+    assert c["reason"] == cond.REASON_INVALID_PARAMS
+    assert ">= 0" in c["message"]
+
+    # Valid value clears the gate and lands on the Job.
+    cur = Model(get(client, "Model", "prbad"))
+    cur.obj["spec"]["params"] = {"model": "debug", "preemption_restarts": 0}
+    client.update(cur.obj)
+    mgr.reconcile_until_stable()
+    c = ko.get_condition(Model(get(client, "Model", "prbad")).obj,
+                         cond.COMPLETE)
     assert c["reason"] != cond.REASON_INVALID_PARAMS
