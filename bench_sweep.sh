@@ -118,6 +118,13 @@ run serve-quant-none env RBT_BENCH_QUANTIZE=none python bench_serve.py
 run serve-quant-int8 env RBT_BENCH_QUANTIZE=int8 python bench_serve.py
 run serve-quant-int4 env RBT_BENCH_QUANTIZE=int4 python bench_serve.py
 
+# 4b. Observability instrumentation overhead (docs/observability.md):
+#     the per-step cost of the obs subsystem (spans + histogram observes +
+#     goodput update) as a percent of the real step time. Acceptance:
+#     < 1% (vs_baseline > 1).
+RBT_BENCH_SKIP_SERVE=1 run train-obs-overhead \
+  env RBT_BENCH_OBS=1 python bench.py
+
 # 5. Fault tolerance (docs/fault-tolerance.md): restart-to-first-step
 #    overhead — restore from the newest intact checkpoint + recompile
 #    (persistent JAX cache warm on accelerator backends). The restart

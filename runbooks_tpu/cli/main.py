@@ -22,6 +22,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import List, Optional
 
@@ -483,45 +484,53 @@ def _sse_chat_once(url: str, messages: List[dict], max_tokens: int,
     return "".join(text)
 
 
+def _resolve_server_url(args, usage: str):
+    """(url, port-forwarder-or-None) for a Server-scoped command: --url is
+    used directly; otherwise resolve the Server's running pod and open an
+    in-process port-forward on an ephemeral local port. Callers stop() the
+    returned forwarder when done."""
+    if args.url:
+        return args.url, None
+    client = make_client(args)
+    kind, name = parse_scope(args.scope)
+    if kind != "Server" or not name:
+        raise SystemExit(usage)
+    obj = client.get(API_VERSION, "Server", args.namespace, name)
+    if obj is None:
+        raise SystemExit(f"servers/{name} not found")
+    if not wait_ready(client, obj, args.timeout):
+        raise SystemExit(1)
+    pod = _server_run_pod(client, args.namespace, name)
+    cfg = getattr(client, "config", None)
+    if pod is None or cfg is None:
+        raise SystemExit(
+            "no running server pod reachable; use --url with an "
+            "existing port-forward")
+    from runbooks_tpu.controller.server import SERVE_PORT
+    from runbooks_tpu.k8s.portforward import PortForwarder
+
+    ready = threading.Event()
+    bound = {}
+
+    def on_ready(p):
+        bound["port"] = p
+        ready.set()
+
+    pf = PortForwarder(cfg, args.namespace, pod, 0, SERVE_PORT,
+                       on_ready=on_ready)
+    threading.Thread(target=pf.serve, daemon=True).start()
+    if not ready.wait(timeout=30):
+        raise SystemExit("port-forward did not become ready")
+    return f"http://127.0.0.1:{bound['port']}", pf
+
+
 def cmd_chat(args) -> int:
     """Interactive streaming chat against a Server (reference analog:
     internal/tui/infer_chat.go — an unused skeleton there; functional
     here). Resolves the server's running pod and opens an in-process
     port-forward unless --url points somewhere directly."""
-    url = args.url
-    pf = None
-    if not url:
-        client = make_client(args)
-        kind, name = parse_scope(args.scope)
-        if kind != "Server" or not name:
-            raise SystemExit("usage: rbt chat servers/<name> | --url URL")
-        obj = client.get(API_VERSION, "Server", args.namespace, name)
-        if obj is None:
-            raise SystemExit(f"servers/{name} not found")
-        if not wait_ready(client, obj, args.timeout):
-            return 1
-        pod = _server_run_pod(client, args.namespace, name)
-        cfg = getattr(client, "config", None)
-        if pod is None or cfg is None:
-            raise SystemExit(
-                "no running server pod reachable; use --url with an "
-                "existing port-forward")
-        from runbooks_tpu.controller.server import SERVE_PORT
-        from runbooks_tpu.k8s.portforward import PortForwarder
-
-        ready = threading.Event()
-        bound = {}
-
-        def on_ready(p):
-            bound["port"] = p
-            ready.set()
-
-        pf = PortForwarder(cfg, args.namespace, pod, 0, SERVE_PORT,
-                           on_ready=on_ready)
-        threading.Thread(target=pf.serve, daemon=True).start()
-        if not ready.wait(timeout=30):
-            raise SystemExit("port-forward did not become ready")
-        url = f"http://127.0.0.1:{bound['port']}"
+    url, pf = _resolve_server_url(
+        args, "usage: rbt chat servers/<name> | --url URL")
 
     messages: List[dict] = []
     if args.system:
@@ -551,6 +560,41 @@ def cmd_chat(args) -> int:
         if pf is not None:
             pf.stop()
     return 0
+
+
+def cmd_profile(args) -> int:
+    """Trigger an on-demand TPU/XLA profiler capture on a live Server
+    (POST /debug/profile, docs/observability.md): traces N seconds of
+    real traffic into the server's {artifacts}/profiles/ — viewable in
+    XProf/TensorBoard from the artifact bucket. No restart, no spec
+    change; the capture window is the only cost."""
+    url, pf = _resolve_server_url(
+        args, "usage: rbt profile servers/<name> [--seconds N] | --url URL")
+    try:
+        req = urllib.request.Request(
+            f"{url}/debug/profile?seconds={args.seconds}", data=b"",
+            headers={"Content-Type": "application/json"})
+        print(f"profiling for {args.seconds}s ...", flush=True)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=args.seconds + 60) as resp:
+                body = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read().decode())["error"]["message"]
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                msg = str(e)
+            print(f"profile failed ({e.code}): {msg}", file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"profile request failed: {e}", file=sys.stderr)
+            return 1
+        print(f"profile written to {body.get('path')} (on the server's "
+              "artifacts mount)")
+        return 0
+    finally:
+        if pf is not None:
+            pf.stop()
 
 
 def cmd_logs(args) -> int:
@@ -720,6 +764,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--temperature", type=float, default=0.7)
     sp.add_argument("--timeout", type=float, default=720.0)
     sp.set_defaults(func=cmd_chat)
+
+    sp = sub.add_parser("profile",
+                        help="capture an on-demand TPU profile from a "
+                             "Server")
+    sp.add_argument("scope", nargs="?", default="")
+    sp.add_argument("--url", help="server URL (skips port-forward)")
+    sp.add_argument("--seconds", type=float, default=5.0,
+                    help="capture window (default 5)")
+    sp.add_argument("--timeout", type=float, default=720.0)
+    sp.set_defaults(func=cmd_profile)
 
     sp = sub.add_parser("logs", help="stream workload pod logs")
     sp.add_argument("scope")

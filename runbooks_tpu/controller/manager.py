@@ -272,12 +272,20 @@ class Manager:
     def _reconcile_one(self, kind: str, obj: dict,
                        pending: Optional[Dict[tuple, float]] = None) -> None:
         from runbooks_tpu.controller.metrics import REGISTRY
+        from runbooks_tpu.obs.trace import span
 
         requeue: Optional[float] = None
         for rec in self.reconcilers.get(kind, ()):
             try:
-                res = rec.reconcile(self.ctx, obj)
+                t0 = time.perf_counter()
+                with span("reconcile", kind=kind, name=ko.name(obj)):
+                    res = rec.reconcile(self.ctx, obj)
                 REGISTRY.inc("controller_reconcile_total", kind=kind)
+                REGISTRY.observe(
+                    "controller_reconcile_seconds",
+                    time.perf_counter() - t0, kind=kind,
+                    help_text="Reconcile duration per kind (one sample "
+                              "per successful reconcile).")
             except Exception:  # noqa: BLE001 — keep the loop alive
                 import traceback
 

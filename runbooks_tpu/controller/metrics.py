@@ -1,76 +1,21 @@
-"""Prometheus-format metrics for the controller manager.
+"""Controller metrics — re-exported from the unified observability
+subsystem.
+
+The minimal private registry that used to live here was promoted to
+``runbooks_tpu.obs.metrics`` (histograms, # HELP/# TYPE exposition, spec
+label escaping, proper content type) so the controller, serve API, and
+trainer share one process-wide registry. Importers of
+``runbooks_tpu.controller.metrics`` keep working unchanged.
 
 Reference analog: controller-runtime's default metrics endpoint
 (--metrics-bind-address :8080 — cmd/controllermanager/main.go) +
-config/prometheus/monitor.yaml. Minimal text-format registry, no deps.
+config/prometheus/monitor.yaml.
 """
 
-from __future__ import annotations
-
-import threading
-import time
-from collections import defaultdict
-from http.server import BaseHTTPRequestHandler, HTTPServer
-from typing import Dict, Tuple
-
-
-class Registry:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] \
-            = defaultdict(float)
-        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
-        self.started = time.time()
-
-    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
-        key = (name, tuple(sorted(labels.items())))
-        with self._lock:
-            self._counters[key] += value
-
-    def set_gauge(self, name: str, value: float, **labels: str) -> None:
-        key = (name, tuple(sorted(labels.items())))
-        with self._lock:
-            self._gauges[key] = value
-
-    def render(self) -> str:
-        lines = []
-        with self._lock:
-            for (name, labels), value in sorted(self._counters.items()):
-                lines.append(_fmt(name, labels, value))
-            for (name, labels), value in sorted(self._gauges.items()):
-                lines.append(_fmt(name, labels, value))
-        lines.append(_fmt("process_uptime_seconds", (),
-                          time.time() - self.started))
-        return "\n".join(lines) + "\n"
-
-
-def _fmt(name: str, labels, value: float) -> str:
-    if labels:
-        inner = ",".join(f'{k}="{v}"' for k, v in labels)
-        return f"{name}{{{inner}}} {value}"
-    return f"{name} {value}"
-
-
-REGISTRY = Registry()
-
-
-def serve_metrics(port: int) -> HTTPServer:
-    class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802
-            if self.path == "/metrics":
-                body = REGISTRY.render().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.end_headers()
-                self.wfile.write(body)
-            else:
-                self.send_response(404)
-                self.end_headers()
-
-        def log_message(self, *args):
-            return
-
-    httpd = HTTPServer(("0.0.0.0", port), Handler)
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    return httpd
+from runbooks_tpu.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    DEFAULT_BUCKETS,
+    REGISTRY,
+    Registry,
+    serve_metrics,
+)

@@ -130,6 +130,19 @@ class ModelReconciler:
             restarts = int(ko.annotations(model.obj).get(
                 RESTARTS_ANNOTATION, "0"))
             if restarts < limit:
+                from runbooks_tpu.controller.metrics import REGISTRY
+                from runbooks_tpu.obs.trace import instant
+
+                # Observability: slice restarts are the single biggest
+                # goodput sink at pod scale — count them per Model so a
+                # preemption-thrashing fleet shows up on /metrics, and
+                # mark the trace so the restart window is attributable.
+                REGISTRY.inc("controller_slice_restarts_total",
+                             model=model.name,
+                             help_text="Train-Job slice recreations "
+                                       "(restart-with-resume).")
+                instant("slice_restart", model=model.name,
+                        attempt=restarts + 1, limit=limit)
                 for j, name in zip(existing_jobs, job_names):
                     if j is not None:
                         ctx.client.delete("batch/v1", "Job",

@@ -1,0 +1,31 @@
+"""Unified observability subsystem: metrics, trace spans, goodput, profiling.
+
+One telemetry surface shared by the trainer, the serve engine/API, the
+controller, and the benches (reference analog: controller-runtime's metrics
+endpoint + config/prometheus/monitor.yaml — but extended with histograms,
+Chrome-trace spans, goodput accounting, and on-demand XLA profiling, which
+the reference has none of; SURVEY.md §5.1). Per-phase timing and goodput
+accounting are what TPU-scale tuning lives on (arXiv:2011.03641,
+arXiv:1909.09756): every perf PR after this one is judged against these
+numbers.
+
+- ``obs.metrics``  — process-wide Prometheus registry (counters, gauges,
+  fixed-bucket histograms) with spec-correct text exposition.
+- ``obs.trace``    — RBT_TRACE=1 JSONL trace spans (Chrome ``trace_event``
+  compatible; loads in Perfetto / chrome://tracing).
+- ``obs.goodput``  — productive-step-time ÷ wall-clock accounting,
+  restart/restore-aware (pairs with docs/fault-tolerance.md resume).
+- ``obs.profile``  — on-demand ``jax.profiler`` capture (serve API
+  ``POST /debug/profile``; trainer ``RBT_PROFILE_AT_STEP``).
+
+See docs/observability.md for the metric catalog and how-tos.
+"""
+
+from runbooks_tpu.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    REGISTRY,
+    Registry,
+    serve_metrics,
+)
+from runbooks_tpu.obs.trace import span, trace_enabled  # noqa: F401
+from runbooks_tpu.obs.goodput import GoodputTracker  # noqa: F401

@@ -1,0 +1,273 @@
+"""Process-wide Prometheus-format metrics registry.
+
+Promoted from the controller's private minimal registry
+(controller/metrics.py, which now re-exports this module) into the one
+registry every layer shares: counters, gauges, and fixed-bucket histograms
+with correct text-format exposition (``# HELP``/``# TYPE`` lines, spec
+label escaping, ``_bucket``/``_sum``/``_count`` series with cumulative
+``le`` buckets). No third-party deps — the exposition format is stable and
+small, and the serving path must not grow a client-library import.
+
+Conventions (enforced by tests/test_obs.py's exposition lint):
+- counters end in ``_total``; gauges and histograms do not
+- histogram families expose ``<name>_bucket{le=...}``, ``<name>_sum``,
+  ``<name>_count``; the ``+Inf`` bucket equals ``_count``
+- every ``# TYPE`` precedes its family's samples
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# The Prometheus text exposition content type. Bare "text/plain" makes some
+# scrapers fall back to heuristic parsing; version + charset is what the
+# official client libraries send.
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Default histogram buckets: latency-shaped (seconds), spanning sub-ms
+# engine dispatches to multi-second cold compiles. 14 buckets keeps each
+# labelset's exposition small; per-metric overrides via observe(buckets=).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+MetricKey = Tuple[str, LabelKey]
+
+
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the Prometheus text format: backslash,
+    double-quote, and line-feed must be escaped or the line is unparseable
+    (and a hostile value could inject fake samples)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(text: str) -> str:
+    """# HELP lines escape backslash and line-feed only (spec)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(name: str, labels: LabelKey, value) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in labels)
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+def _key(name: str, labels: Dict[str, str]) -> MetricKey:
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+class _Histogram:
+    """One histogram labelset: cumulative bucket counts + sum + count."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Sequence[float]):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * len(self.bounds)   # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.bounds, value)
+        if i < len(self.counts):
+            self.counts[i] += 1
+        # values above the top bound land only in +Inf (== count)
+        self.sum += value
+        self.count += 1
+
+    def cumulative(self) -> List[int]:
+        out, acc = [], 0
+        for c in self.counts:
+            acc += c
+            out.append(acc)
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile from the buckets (linear interpolation
+        inside the containing bucket, like PromQL's histogram_quantile).
+        Returns the top finite bound when the quantile lands in +Inf."""
+        if self.count == 0:
+            return float("nan")
+        rank = q * self.count
+        acc = 0
+        lo = 0.0
+        for bound, c in zip(self.bounds, self.counts):
+            if acc + c >= rank and c > 0:
+                frac = (rank - acc) / c
+                return lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+            acc += c
+            lo = bound
+        return self.bounds[-1] if self.bounds else float("nan")
+
+
+class Registry:
+    """Thread-safe metrics registry rendering Prometheus text format.
+
+    ``inc`` accumulates counters; ``set_counter`` mirrors an externally
+    maintained monotonic count (e.g. the serve engine's own totals) as an
+    absolute value at scrape time; ``set_gauge`` sets gauges; ``observe``
+    records into a fixed-bucket histogram. ``help_text`` registered on
+    first use (or via ``describe``) renders as ``# HELP``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[MetricKey, float] = defaultdict(float)
+        self._gauges: Dict[MetricKey, object] = {}
+        self._hists: Dict[MetricKey, _Histogram] = {}
+        self._help: Dict[str, str] = {}
+        self.started = time.time()
+
+    # -- write side ----------------------------------------------------
+
+    def describe(self, name: str, help_text: str) -> None:
+        with self._lock:
+            self._help[name] = help_text
+
+    def inc(self, name: str, value: float = 1.0, /, *,
+            help_text: Optional[str] = None, **labels: str) -> None:
+        key = _key(name, labels)
+        with self._lock:
+            self._counters[key] += value
+            if help_text:
+                self._help.setdefault(name, help_text)
+
+    def set_counter(self, name: str, value: float, /, *,
+                    help_text: Optional[str] = None, **labels: str) -> None:
+        """Absolute-value counter (for mirroring a count the source object
+        maintains itself — e.g. engine.steps — at scrape time)."""
+        key = _key(name, labels)
+        with self._lock:
+            self._counters[key] = float(value)
+            if help_text:
+                self._help.setdefault(name, help_text)
+
+    def set_gauge(self, name: str, value, /, *,
+                  help_text: Optional[str] = None, **labels: str) -> None:
+        key = _key(name, labels)
+        with self._lock:
+            self._gauges[key] = value
+            if help_text:
+                self._help.setdefault(name, help_text)
+
+    def observe(self, name: str, value: float, /, *,
+                buckets: Optional[Sequence[float]] = None,
+                help_text: Optional[str] = None, **labels: str) -> None:
+        key = _key(name, labels)
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = _Histogram(
+                    buckets if buckets is not None else DEFAULT_BUCKETS)
+            hist.observe(float(value))
+            if help_text:
+                self._help.setdefault(name, help_text)
+
+    # -- read side -----------------------------------------------------
+
+    def quantile(self, name: str, q: float, /, **labels: str) -> float:
+        with self._lock:
+            hist = self._hists.get(_key(name, labels))
+            return hist.quantile(q) if hist is not None else float("nan")
+
+    def counter_value(self, name: str, /, **labels: str) -> float:
+        with self._lock:
+            return self._counters.get(_key(name, labels), 0.0)
+
+    def render(self) -> str:
+        """Prometheus text format, grouped per family: ``# HELP`` and
+        ``# TYPE`` precede every family's samples (required by the spec —
+        fixing the old renderer, whose interleaved sorted dump had no type
+        lines at all)."""
+        lines: List[str] = []
+        with self._lock:
+            families: Dict[str, List[Tuple[str, LabelKey, object]]] = {}
+            types: Dict[str, str] = {}
+            for (name, labels), value in sorted(self._counters.items()):
+                families.setdefault(name, []).append((name, labels, value))
+                types[name] = "counter"
+            for (name, labels), value in sorted(self._gauges.items()):
+                families.setdefault(name, []).append((name, labels, value))
+                types[name] = "gauge"
+            uptime = time.time() - self.started
+            families.setdefault("process_uptime_seconds", []).append(
+                ("process_uptime_seconds", (), uptime))
+            types["process_uptime_seconds"] = "gauge"
+            self._help.setdefault("process_uptime_seconds",
+                                  "Seconds since this registry was created.")
+            for name in sorted(families):
+                if name in self._help:
+                    lines.append(
+                        f"# HELP {name} {escape_help(self._help[name])}")
+                lines.append(f"# TYPE {name} {types[name]}")
+                for sample_name, labels, value in families[name]:
+                    lines.append(_fmt(sample_name, labels, value))
+            hist_names = sorted({name for name, _ in self._hists})
+            for name in hist_names:
+                if name in self._help:
+                    lines.append(
+                        f"# HELP {name} {escape_help(self._help[name])}")
+                lines.append(f"# TYPE {name} histogram")
+                for (hname, labels), hist in sorted(self._hists.items()):
+                    if hname != name:
+                        continue
+                    cum = hist.cumulative()
+                    for bound, c in zip(hist.bounds, cum):
+                        bl = labels + (("le", f"{bound:g}"),)
+                        lines.append(_fmt(f"{name}_bucket", bl, c))
+                    lines.append(_fmt(f"{name}_bucket",
+                                      labels + (("le", "+Inf"),),
+                                      hist.count))
+                    lines.append(_fmt(f"{name}_sum", labels,
+                                      round(hist.sum, 9)))
+                    lines.append(_fmt(f"{name}_count", labels, hist.count))
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Drop all series (tests; a process never needs this)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+# The process-wide registry: controller, serve API, trainer, and benches all
+# record here, so one /metrics scrape sees every layer living in the process.
+REGISTRY = Registry()
+
+
+def serve_metrics(port: int, registry: Optional[Registry] = None) -> HTTPServer:
+    """Serve GET /metrics on a background thread (controller-manager's
+    metrics endpoint; reference: controller-runtime --metrics-bind-address).
+    port=0 binds an ephemeral port (tests); read it back from
+    ``httpd.server_address``."""
+    reg = registry if registry is not None else REGISTRY
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path == "/metrics":
+                body = reg.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args):
+            return
+
+    httpd = HTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -1,0 +1,192 @@
+"""Lightweight JSONL trace spans (Chrome ``trace_event`` compatible).
+
+``RBT_TRACE=1`` turns emission on; everything else is a near-zero-cost
+no-op (one env lookup + one shared null context manager per span, so the
+instrumented hot loops — trainer steps, engine ticks, reconciles — pay
+nothing when tracing is off).
+
+File format: the Chrome/Perfetto "JSON Array Format" with one event per
+line — an opening ``[`` line, then ``{...},`` per event. The spec allows
+the closing ``]`` to be omitted, so the file is loadable in Perfetto /
+chrome://tracing at any moment (including mid-run or after a crash), and
+each line (minus the trailing comma) is a complete JSON object — greppable
+and streamable like any JSONL log.
+
+Default output: ``{artifacts}/trace.jsonl`` (the container contract's
+durable mount); ``configure(path)`` repoints it (the trainer does, per
+run). Writes are lock-serialized line appends, so concurrent spans from
+the engine worker, checkpoint threads, and reconcilers interleave without
+tearing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+def trace_enabled() -> bool:
+    """Read the switch per call (not cached at import): tests and operators
+    flip RBT_TRACE around individual runs."""
+    return os.environ.get("RBT_TRACE", "") == "1"
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._path: Optional[str] = None
+        self._file = None
+
+    def configure(self, path: Optional[str]) -> None:
+        with self._lock:
+            if self._file is not None and path != self._path:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+            self._path = path
+
+    def path(self) -> Optional[str]:
+        with self._lock:
+            if self._path is not None:
+                return self._path
+        from runbooks_tpu.utils import contract
+
+        return os.path.join(contract.artifacts_dir(), "trace.jsonl")
+
+    def write(self, event: dict) -> None:
+        line = json.dumps(event, separators=(",", ":"))
+        with self._lock:
+            if self._file is None:
+                path = self._path
+                if path is None:
+                    from runbooks_tpu.utils import contract
+
+                    path = os.path.join(contract.artifacts_dir(),
+                                        "trace.jsonl")
+                    self._path = path
+                try:
+                    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                    fresh = (not os.path.exists(path)
+                             or os.path.getsize(path) == 0)
+                    self._file = open(path, "a", buffering=1)
+                    if fresh:
+                        self._file.write("[\n")
+                except OSError:
+                    # Tracing must never take down the workload: an
+                    # unwritable path drops this event. The CONFIGURED
+                    # path is kept (resetting it would silently reroute
+                    # the rest of the run's spans to the contract-default
+                    # location); the next write retries the open — e.g. a
+                    # not-yet-mounted artifacts volume heals in place.
+                    return
+            try:
+                self._file.write(line + ",\n")
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+
+_WRITER = _Writer()
+
+
+def configure(path: Optional[str]) -> None:
+    """Repoint trace output (e.g. the trainer sets
+    ``{artifacts}/trace.jsonl`` for its run). None reverts to the
+    contract default."""
+    _WRITER.configure(path)
+
+
+def close() -> None:
+    """Flush and close the trace file (end of a run; the next span
+    reopens in append mode)."""
+    _WRITER.close()
+
+
+class _Span:
+    """One complete event (``ph: "X"``): records wall-clock start and
+    monotonic duration, written at exit."""
+
+    __slots__ = ("name", "args", "_ts", "_t0")
+
+    def __init__(self, name: str, args: dict):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._ts = time.time() * 1e6          # trace_event ts is in µs
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = (time.perf_counter() - self._t0) * 1e6
+        event = {
+            "name": self.name,
+            "ph": "X",
+            "ts": round(self._ts, 1),
+            "dur": round(dur, 1),
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+        }
+        if self.args:
+            event["args"] = self.args
+        if exc_type is not None:
+            event.setdefault("args", {})["error"] = exc_type.__name__
+        _WRITER.write(event)
+        return False
+
+
+def span(name: str, /, **args):
+    """Context manager tracing one phase: ``with span("prefill",
+    bucket=128): ...``. Emits a Chrome complete event when RBT_TRACE=1;
+    otherwise returns a shared no-op (no allocation beyond the env read).
+    ``name`` is positional-only so span attributes may freely use "name"
+    as a key (e.g. reconcile spans labeling the object name)."""
+    if not trace_enabled():
+        return _NULL
+    return _Span(name, args)
+
+
+def instant(name: str, /, **args) -> None:
+    """Point-in-time marker (``ph: "i"``): checkpoint landed, preemption
+    signal caught, profile started."""
+    if not trace_enabled():
+        return
+    event = {
+        "name": name,
+        "ph": "i",
+        "s": "p",
+        "ts": round(time.time() * 1e6, 1),
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0x7FFFFFFF,
+    }
+    if args:
+        event["args"] = args
+    _WRITER.write(event)

@@ -447,22 +447,84 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         return web.json_response({"ok": True})
 
     async def metrics(request: web.Request) -> web.Response:
+        """Prometheus exposition from the unified registry
+        (runbooks_tpu.obs): request/engine totals mirrored at scrape time
+        from this app's engine (absolute values, so concurrent server
+        instances in one process each scrape their own truth), plus the
+        latency histograms (TTFT, inter-token, queue-wait, end-to-end,
+        prefill/decode dispatch) the engine records as it serves."""
+        from runbooks_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.REGISTRY
         eng = worker.engine
-        lines = [
-            f"serve_requests_total {app['requests_total']}",
-            f"serve_requests_failed_total {app['requests_failed_total']}",
-            f"serve_tokens_generated_total {app['tokens_total']}",
-            f"serve_decode_steps_total {eng.steps}",
-            f"serve_active_slots {int(eng.active.sum())}",
-            f"serve_queue_depth {len(eng.queue)}",
-            f"serve_queue_limit {eng.max_queue}",
-            f"serve_requests_rejected_total {app['requests_rejected_total']}",
-            f"serve_deadline_expired_total {eng.deadline_expired}",
-            f"serve_draining {int(worker._draining)}",
-            f"serve_prefix_tokens_reused_total {eng.prefix_tokens_reused}",
-        ]
-        return web.Response(text="\n".join(lines) + "\n",
-                            content_type="text/plain")
+        reg.set_counter("serve_requests_total", app["requests_total"],
+                        help_text="Requests accepted by the HTTP API.")
+        reg.set_counter("serve_requests_failed_total",
+                        app["requests_failed_total"],
+                        help_text="Requests that errored or timed out.")
+        reg.set_counter("serve_tokens_generated_total", app["tokens_total"],
+                        help_text="Completion tokens returned to clients.")
+        reg.set_counter("serve_decode_steps_total", eng.steps,
+                        help_text="Engine decode chunks executed.")
+        reg.set_gauge("serve_active_slots", int(eng.active.sum()),
+                      help_text="Slots currently decoding.")
+        reg.set_gauge("serve_queue_depth", len(eng.queue),
+                      help_text="Requests waiting for a slot.")
+        reg.set_gauge("serve_queue_limit", eng.max_queue,
+                      help_text="Admission queue bound (429 past this).")
+        reg.set_counter("serve_requests_rejected_total",
+                        app["requests_rejected_total"],
+                        help_text="Requests shed with 429/503.")
+        reg.set_counter("serve_deadline_expired_total", eng.deadline_expired,
+                        help_text="Requests finished by wall-clock "
+                                  "deadline.")
+        reg.set_gauge("serve_draining", int(worker._draining),
+                      help_text="1 while the server drains for shutdown.")
+        reg.set_counter("serve_prefix_tokens_reused_total",
+                        eng.prefix_tokens_reused,
+                        help_text="Prompt tokens served from the shared-"
+                                  "prefix KV cache instead of prefill.")
+        body = reg.render().encode("utf-8")
+        return web.Response(
+            body=body, headers={"Content-Type": obs_metrics.CONTENT_TYPE})
+
+    async def debug_profile(request: web.Request) -> web.Response:
+        """On-demand TPU/XLA profiler capture: POST /debug/profile
+        ?seconds=N (or JSON body {"seconds": N}) traces N seconds of live
+        traffic into {artifacts}/profiles/<stamp>-serve (XProf/
+        TensorBoard-loadable). One capture at a time -> 409 while busy."""
+        from runbooks_tpu.obs import profile as obs_profile
+
+        seconds = request.query.get("seconds")
+        if seconds is None and request.can_read_body:
+            try:
+                seconds = (await request.json()).get("seconds")
+            except (json.JSONDecodeError, AttributeError):
+                seconds = None
+        try:
+            seconds = float(seconds if seconds is not None else 3.0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "seconds must be a number"}},
+                status=400)
+        if not 0 < seconds <= 300:
+            return web.json_response(
+                {"error": {"message": "seconds must be in (0, 300]"}},
+                status=400)
+        log_dir = obs_profile.capture_dir(tag="serve")
+        try:
+            # Blocking timed capture off the event loop: SSE streams and
+            # new admissions keep flowing while the profiler records them.
+            await asyncio.get_running_loop().run_in_executor(
+                None, obs_profile.PROFILER.capture, log_dir, seconds)
+        except obs_profile.ProfilerBusy as exc:
+            return web.json_response(
+                {"error": {"message": str(exc)}}, status=409)
+        except Exception as exc:  # noqa: BLE001 — profiler plumbing failed
+            return web.json_response(
+                {"error": {"message": f"profile capture failed: {exc}"}},
+                status=500)
+        return web.json_response({"path": log_dir, "seconds": seconds})
 
     async def completions(request: web.Request) -> web.Response:
         try:
@@ -801,6 +863,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     app.router.add_get("/", root)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_post("/debug/profile", debug_profile)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/prefix", register_prefix)

@@ -48,9 +48,27 @@ import numpy as np
 
 from runbooks_tpu.models.config import ModelConfig
 from runbooks_tpu.models.transformer import KVCache, forward
+from runbooks_tpu.obs import metrics as obs_metrics
+from runbooks_tpu.obs.trace import span, trace_enabled
 from runbooks_tpu.ops.sampling import sample
 
 Params = Any
+
+# Inter-token gaps run from microseconds (host replay inside a decode
+# chunk) to chunk wall time; the default latency buckets start at 1 ms and
+# would flatten the distribution's whole left half into one bucket.
+_INTER_TOKEN_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def _observe_request_done(req: "Request", now: float) -> None:
+    """Terminal latency accounting for one request (normal finish or
+    deadline expiry): end-to-end duration, labeled by finish reason."""
+    obs_metrics.REGISTRY.observe(
+        "serve_request_duration_seconds", now - req._submitted,
+        reason=req.finish_reason or "stop",
+        help_text="End-to-end request latency (submit to finish).")
 
 
 class EngineOverloaded(RuntimeError):
@@ -94,6 +112,8 @@ class Request:
     on_token: Optional[Callable[[int], None]] = None
     _slot: int = -1
     _submitted: float = 0.0   # monotonic submit time (deadline anchor)
+    _admitted: float = 0.0    # monotonic admission time (queue-wait end)
+    _last_token_t: float = 0.0  # previous token's host-observed time
 
 
 def _buckets(max_prefill: int) -> List[int]:
@@ -707,6 +727,12 @@ class InferenceEngine:
             if admitted and need > budget:
                 break
             req = self.queue.pop(0)
+            req._admitted = time.monotonic()
+            obs_metrics.REGISTRY.observe(
+                "serve_queue_wait_seconds",
+                req._admitted - req._submitted,
+                help_text="Admission-queue wait (submit to slot "
+                          "assignment).")
             budget -= need
             admitted.append((slot, req, pkey))
         if not admitted:
@@ -766,7 +792,11 @@ class InferenceEngine:
                 jnp.asarray(slots), jnp.asarray(last_pos), self.rng,
                 jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps))
-        with self._mesh_ctx():
+        # Dispatch timing is host-side, outside jit (the np.asarray pull
+        # below is the device sync) — zero effect on compiled programs.
+        t_dispatch = time.perf_counter()
+        with span("prefill", bucket=bucket, rows=rows, prefix=plen), \
+                self._mesh_ctx():
             if pkey:
                 # Admission hit refreshes the LRU position: the prefix
                 # serving live traffic must not be the one evicted.
@@ -778,7 +808,12 @@ class InferenceEngine:
             else:
                 first, self.cache, self.rng = self._prefill(
                     self.params, self.cache, *args)
-        first = np.asarray(first)
+            first = np.asarray(first)
+        obs_metrics.REGISTRY.observe(
+            "serve_prefill_dispatch_seconds",
+            time.perf_counter() - t_dispatch, bucket=str(bucket),
+            help_text="Prefill dispatch+sync wall time per admission "
+                      "group, labeled by prompt bucket.")
         for i, (slot, req) in enumerate(group):
             tok = int(first[i])
             self.active[slot] = True
@@ -792,6 +827,24 @@ class InferenceEngine:
         req = self.slot_req[slot]
         assert req is not None
         req.output_tokens.append(tok)
+        # Latency histograms, host-observed: TTFT on the first token,
+        # inter-token gaps after. Chunked decode replays a chunk's tokens
+        # in one host loop, so within-chunk gaps are microseconds and the
+        # chunk's first token carries the chunk wall time — exactly the
+        # burst cadence an SSE client observes (docs/observability.md).
+        now = time.monotonic()
+        reg = obs_metrics.REGISTRY
+        if len(req.output_tokens) == 1:
+            reg.observe("serve_ttft_seconds", now - req._submitted,
+                        help_text="Time to first generated token "
+                                  "(submit to first sampled token).")
+        else:
+            reg.observe("serve_inter_token_seconds",
+                        now - req._last_token_t,
+                        buckets=_INTER_TOKEN_BUCKETS,
+                        help_text="Host-observed gap between consecutive "
+                                  "generated tokens of one request.")
+        req._last_token_t = now
         if req.on_token is not None:
             req.on_token(tok)
         hit_eos = req.eos_id is not None and tok == req.eos_id
@@ -805,6 +858,7 @@ class InferenceEngine:
             req.finish_reason = "stop" if hit_eos else "length"
             self.active[slot] = False
             self.slot_req[slot] = None
+            _observe_request_done(req, now)
 
     def _expire_deadlines(self) -> List[int]:
         """Finish requests whose wall-clock deadline passed (between decode
@@ -827,6 +881,7 @@ class InferenceEngine:
             if expired(r):
                 r.finished = True
                 r.finish_reason = "deadline"
+                _observe_request_done(r, now)
                 n += 1
             else:
                 keep.append(r)
@@ -838,6 +893,7 @@ class InferenceEngine:
             if self.active[slot] and req is not None and expired(req):
                 req.finished = True
                 req.finish_reason = "deadline"
+                _observe_request_done(req, now)
                 self.active[slot] = False
                 self.slot_req[slot] = None
                 freed.append(slot)
@@ -875,15 +931,26 @@ class InferenceEngine:
             for i in range(self.max_slots)], np.int32)
         view = self._view_for(int(self.lengths[self.active].max())
                               + self.decode_chunk)
-        with self._mesh_ctx():
+        t_dispatch = time.perf_counter()
+        # The active-count span attr is computed only when tracing is on:
+        # span() itself is a no-op when off, but eager kwargs would still
+        # charge the decode hot loop an array reduction per chunk.
+        attrs = ({"active": int(self.active.sum())}
+                 if trace_enabled() else {})
+        with span("decode", view=view, **attrs), self._mesh_ctx():
             toks, valid, self.cache, self.rng = self._decode_for(view)(
                 self.params, self.cache, jnp.asarray(self.last_token),
                 jnp.asarray(positions), self.rng,
                 jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
                 jnp.asarray(eos_ids), jnp.asarray(remaining),
                 jnp.asarray(self.active))
-        toks = np.asarray(toks)          # [chunk, slots]
-        valid = np.asarray(valid)        # [chunk, slots] bool
+            toks = np.asarray(toks)          # [chunk, slots]
+            valid = np.asarray(valid)        # [chunk, slots] bool
+        obs_metrics.REGISTRY.observe(
+            "serve_decode_dispatch_seconds",
+            time.perf_counter() - t_dispatch, view=str(view),
+            help_text="Decode-chunk dispatch+sync wall time, labeled by "
+                      "cache view bucket.")
         # Replay the chunk on the host: `valid[k]` is exactly the set of
         # slots that were alive at device step k, so this loop lands in the
         # same bookkeeping state as chunk=1 stepping would.

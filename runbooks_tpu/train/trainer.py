@@ -25,6 +25,11 @@ import jax
 import numpy as np
 
 from runbooks_tpu.models.config import ModelConfig, get_config
+from runbooks_tpu.obs import trace as obs_trace
+from runbooks_tpu.obs.goodput import GoodputTracker
+from runbooks_tpu.obs.metrics import REGISTRY
+from runbooks_tpu.obs.profile import PROFILER, parse_profile_at_step
+from runbooks_tpu.obs.trace import span
 from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
 from runbooks_tpu.train import data as data_mod
 from runbooks_tpu.train.checkpoint import CheckpointManager
@@ -239,6 +244,10 @@ def run_training(job: TrainJobConfig,
     optimizer = make_optimizer(job.optimizer)
     artifacts = job.artifacts_dir or contract.artifacts_dir()
     os.makedirs(artifacts, exist_ok=True)
+    if obs_trace.trace_enabled():
+        # Trace spans (RBT_TRACE=1) land next to the run's other
+        # artifacts; loadable in Perfetto mid-run (docs/observability.md).
+        obs_trace.configure(os.path.join(artifacts, "trace.jsonl"))
     # Persistent compile cache in the durable artifacts mount: a restarted
     # Job (slice restart / resume) skips the full XLA recompile.
     from runbooks_tpu.utils.jax_cache import enable_compilation_cache
@@ -283,6 +292,10 @@ def run_training(job: TrainJobConfig,
 
     # May raise on a malformed value — before any state needing cleanup.
     fault = _parse_fault_inject()
+    # RBT_PROFILE_AT_STEP=n[:k]: on-demand capture of k steps starting at
+    # step n into {artifacts}/profiles/ (docs/observability.md). Parsed
+    # here for the same reason as the fault hook.
+    profile_at = parse_profile_at_step()
 
     start_step = 0
     consumed = 0          # batches pulled from the data stream (the cursor)
@@ -301,11 +314,56 @@ def run_training(job: TrainJobConfig,
     compile_time_s = None
 
     profiling = False
+    profiling_at = False   # RBT_PROFILE_AT_STEP capture in flight
     exit_reason = None
     bad_streak = 0
     nonfinite_steps = 0
     pending_nf = None      # previous step's (index, nonfinite flag)
     last_saved = -1
+
+    # Goodput accounting (obs/goodput.py): productive step time ÷ wall
+    # clock, with restart overhead (restore + compile) excluded so a
+    # preempted-and-resumed run reports steady-state goodput, not a ratio
+    # dragged down by however long the restore took. The clock starts
+    # here — before restore — so restore genuinely lands inside the wall.
+    goodput = GoodputTracker()
+    # Per-log-window phase sums; each history entry reports window means.
+    win = {"data": 0.0, "step": 0.0, "ckpt": 0.0, "steps": 0}
+
+    def _summary_dict(in_progress: bool = False) -> Dict[str, Any]:
+        s = {
+            "final_loss": history[-1]["loss"] if history else None,
+            "steps": job.steps,
+            "tokens_per_sec": (history[-1]["tokens_per_sec"]
+                               if history else None),
+            "compile_time_s": compile_time_s,
+            "restore_time_s": restore_time_s,
+            "accumulate_steps": job.accumulate_steps,
+            "model": job.model,
+            "lora": lora_mode,
+            "exit_reason": exit_reason,
+            "nonfinite_steps": nonfinite_steps,
+            "batches_consumed": consumed,
+            "goodput": goodput.ratio() if goodput.steps else None,
+            "goodput_detail": goodput.snapshot(),
+            "history": history,
+        }
+        if in_progress:
+            s["in_progress"] = True
+        return s
+
+    def _write_metrics(summary: Optional[Dict[str, Any]] = None) -> None:
+        # Atomic (temp + os.replace) AND incremental (every log point):
+        # a preempted run keeps its metrics history up to the last log
+        # line instead of losing all of it — the checkpoint survived
+        # preemption since PR 4; now the telemetry does too. A torn write
+        # can never be observed: readers see the old file or the new one.
+        path = os.path.join(artifacts, "metrics.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(summary if summary is not None
+                      else _summary_dict(in_progress=True), f, indent=2)
+        os.replace(tmp, path)
 
     def _check_nonfinite(pending) -> None:
         # Checked one step LATE on purpose: pulling the flag then only
@@ -341,8 +399,12 @@ def run_training(job: TrainJobConfig,
     try:
         if job.resume and ckpt.latest_intact_step() is not None:
             t_restore = time.perf_counter()
-            state, cursor, _ckpt_step = ckpt.restore_with_cursor(state)
+            with span("restore"):
+                state, cursor, _ckpt_step = ckpt.restore_with_cursor(state)
             restore_time_s = time.perf_counter() - t_restore
+            # Restart overhead, not steady-state time: excluded from the
+            # goodput window, reported separately in goodput_detail.
+            goodput.exclude(restore_time_s, "restore")
             start_step = int(state.step)
             last_saved = start_step
             # Legacy (pre-cursor) checkpoints: every step consumes exactly
@@ -408,43 +470,96 @@ def run_training(job: TrainJobConfig,
                     exit_reason = stop["reason"]
                     break
                 if job.profile_stop > job.profile_start \
-                        and i == job.profile_start:
-                    jax.profiler.start_trace(
-                        os.path.join(artifacts, "profile"))
+                        and i == job.profile_start and not profiling_at:
+                    PROFILER.start(os.path.join(artifacts, "profile"))
                     profiling = True
-                batch = next(batches)
-                consumed += 1
-                if prefetcher is None:
-                    batch = {k: np.asarray(v) for k, v in batch.items()}
+                if profile_at is not None and i == profile_at[0] \
+                        and not (profiling or profiling_at):
+                    PROFILER.start(os.path.join(
+                        artifacts, "profiles", f"step{profile_at[0]}"))
+                    profiling_at = True
+                t_data = time.perf_counter()
+                with span("data_wait", step=i):
+                    batch = next(batches)
+                    consumed += 1
+                    if prefetcher is None:
+                        batch = {k: np.asarray(v) for k, v in batch.items()}
+                data_wait_s = time.perf_counter() - t_data
                 if _fault_due(i, "nonfinite"):
                     batch = dict(batch)
                     batch["loss_mask"] = batch["loss_mask"] * float("nan")
-                if lora_mode:
-                    state, metrics = step_fn(state, base_params, batch)
-                else:
-                    state, metrics = step_fn(state, batch)
+                t_step = time.perf_counter()
+                with span("step", step=i):
+                    if lora_mode:
+                        state, metrics = step_fn(state, base_params, batch)
+                    else:
+                        state, metrics = step_fn(state, batch)
+                step_s = time.perf_counter() - t_step
                 _check_nonfinite(pending_nf)
                 pending_nf = (i, metrics.get("nonfinite"))
                 if i == start_step:
                     # The first step folds the XLA compile; pulling the
                     # loss waits for it, then the throughput window resets
                     # so tokens/sec and MFU report steady-state compute
-                    # (compile time lands in its own field).
+                    # (compile time lands in its own field). The whole
+                    # window is restart/startup overhead for goodput.
                     float(metrics["loss"])
                     compile_time_s = time.perf_counter() - t_start
+                    goodput.exclude(compile_time_s, "compile")
                     t_start = time.perf_counter()
                 else:
                     tokens_done += tokens_per_step
                 if profiling and i + 1 == job.profile_stop:
                     jax.block_until_ready(metrics["loss"])
-                    jax.profiler.stop_trace()
+                    PROFILER.stop()
                     profiling = False
-                if (i + 1) % job.log_every == 0 or i + 1 == job.steps:
+                if profiling_at \
+                        and i + 1 == profile_at[0] + profile_at[1]:
+                    jax.block_until_ready(metrics["loss"])
+                    PROFILER.stop()
+                    profiling_at = False
+                is_log = (i + 1) % job.log_every == 0 or i + 1 == job.steps
+                if is_log:
                     # Only log points sync on the device (float pulls the
                     # scalar); between them steps dispatch async with
-                    # metrics buffered as device arrays.
+                    # metrics buffered as device arrays. The sync wait is
+                    # device compute finishing — step time, not overhead.
+                    t_sync = time.perf_counter()
                     loss = float(metrics["loss"])
-                    dt = time.perf_counter() - t_start
+                    t_synced = time.perf_counter()
+                    dt = t_synced - t_start
+                    if i != start_step:
+                        step_s += t_synced - t_sync
+                ckpt_s = 0.0
+                if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:
+                    t_ckpt = time.perf_counter()
+                    with span("checkpoint", step=i + 1):
+                        ckpt.save(i + 1, state,
+                                  cursor={"batches_consumed": consumed})
+                    ckpt_s = time.perf_counter() - t_ckpt
+                    last_saved = i + 1
+                if i != start_step:
+                    # Per-step breakdown: registry histograms + the
+                    # goodput accumulator. The compile step is excluded
+                    # wholesale above — recording it here too would count
+                    # the same seconds twice.
+                    goodput.step(step_s, data_wait_s, ckpt_s)
+                    REGISTRY.observe(
+                        "train_step_seconds", step_s,
+                        help_text="Per-step compute wall time (dispatch "
+                                  "+ device sync share).")
+                    REGISTRY.observe(
+                        "train_data_wait_seconds", data_wait_s,
+                        help_text="Per-step input-pipeline wait.")
+                    if ckpt_s:
+                        REGISTRY.observe(
+                            "train_checkpoint_seconds", ckpt_s,
+                            help_text="Blocking checkpoint save time.")
+                    win["data"] += data_wait_s
+                    win["step"] += step_s
+                    win["ckpt"] += ckpt_s
+                    win["steps"] += 1
+                if is_log:
                     if tokens_done:
                         tps = tokens_done / max(dt, 1e-9)
                     else:  # single measured step: only the compile window
@@ -457,12 +572,27 @@ def run_training(job: TrainJobConfig,
                         entry["mfu"] = round(achieved / peak_flops, 4)
                     if not history and compile_time_s is not None:
                         entry["compile_time_s"] = round(compile_time_s, 2)
+                    if win["steps"]:
+                        # Step-time breakdown (window means) + running
+                        # goodput: the is-it-input-bound answer, on every
+                        # log line instead of behind a debugger.
+                        entry["data_wait_s"] = round(
+                            win["data"] / win["steps"], 4)
+                        entry["step_s"] = round(
+                            win["step"] / win["steps"], 4)
+                        if win["ckpt"]:
+                            entry["ckpt_s"] = round(
+                                win["ckpt"] / win["steps"], 4)
+                        entry["goodput"] = round(goodput.ratio(), 4)
+                        REGISTRY.set_gauge(
+                            "train_goodput_ratio", entry["goodput"],
+                            help_text="Productive step time / wall clock "
+                                      "(restart overhead excluded).")
+                    win = {"data": 0.0, "step": 0.0, "ckpt": 0.0,
+                           "steps": 0}
                     history.append(entry)
                     print(json.dumps(entry), flush=True)
-                if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:
-                    ckpt.save(i + 1, state,
-                              cursor={"batches_consumed": consumed})
-                    last_saved = i + 1
+                    _write_metrics()
             if exit_reason is None:
                 _check_nonfinite(pending_nf)
             else:
@@ -472,9 +602,13 @@ def run_training(job: TrainJobConfig,
                 # periodic save if the stop landed right after one.
                 step_now = int(state.step)
                 if step_now != last_saved:
-                    ckpt.save(step_now, state,
-                              cursor={"batches_consumed": consumed},
-                              force=True)
+                    with span("emergency_save", step=step_now,
+                              reason=exit_reason):
+                        ckpt.save(step_now, state,
+                                  cursor={"batches_consumed": consumed},
+                                  force=True)
+                obs_trace.instant("preempted", reason=exit_reason,
+                                  step=step_now)
                 print(json.dumps({"preempted": exit_reason,
                                   "emergency_checkpoint_step": step_now}),
                       flush=True)
@@ -498,25 +632,15 @@ def run_training(job: TrainJobConfig,
         finally:
             for sig, old in restore_sigs:
                 signal.signal(sig, old)
+            if obs_trace.trace_enabled():
+                # Flush the run's trace file (the writer reopens in
+                # append mode if anything traces after this).
+                obs_trace.close()
 
-    if profiling:  # profile window ran past the last step
-        jax.profiler.stop_trace()
-    summary = {
-        "final_loss": history[-1]["loss"] if history else None,
-        "steps": job.steps,
-        "tokens_per_sec": history[-1]["tokens_per_sec"] if history else None,
-        "compile_time_s": compile_time_s,
-        "restore_time_s": restore_time_s,
-        "accumulate_steps": job.accumulate_steps,
-        "model": job.model,
-        "lora": lora_mode,
-        "exit_reason": exit_reason,
-        "nonfinite_steps": nonfinite_steps,
-        "batches_consumed": consumed,
-        "history": history,
-    }
-    with open(os.path.join(artifacts, "metrics.json"), "w") as f:
-        json.dump(summary, f, indent=2)
+    if profiling or profiling_at:  # profile window ran past the last step
+        PROFILER.stop()
+    summary = _summary_dict()
+    _write_metrics(summary)
     if lora_mode:
         # Export merged params reference for serving (artifact contract).
         merged_note = {"note": "merged weights = base + lora; see checkpoints"}
