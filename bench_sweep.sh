@@ -120,10 +120,19 @@ run serve-quant-int4 env RBT_BENCH_QUANTIZE=int4 python bench_serve.py
 
 # 4b. Observability instrumentation overhead (docs/observability.md):
 #     the per-step cost of the obs subsystem (spans + histogram observes +
-#     goodput update) as a percent of the real step time. Acceptance:
+#     goodput update) as a percent of the real step time, PLUS the fleet-
+#     scraper bound (a 5 Hz /metrics scrape loop must not move the step
+#     time — scrape_wall_delta_pct in the same JSON line). Acceptance:
 #     < 1% (vs_baseline > 1).
 RBT_BENCH_SKIP_SERVE=1 run train-obs-overhead \
   env RBT_BENCH_OBS=1 python bench.py
+
+# 4c. Fleet telemetry smoke (docs/observability.md): the controller
+#     scrape loop against live replica /metrics endpoints end to end —
+#     per-replica mirroring, freshness gauges, merged-histogram summary.
+#     Value is the sweep wall time (must stay well under 1 s at smoke
+#     scale; vs_baseline > 1).
+run fleet-scrape-smoke python tools/fleet_smoke.py 4
 
 # 5. Fault tolerance (docs/fault-tolerance.md): restart-to-first-step
 #    overhead — restore from the newest intact checkpoint + recompile

@@ -13,6 +13,11 @@ COMPLETE = "Complete"
 SERVING = "Serving"
 SUSPENDED = "Suspended"
 LAUNCHED = "Launched"
+# Declarative serving SLOs (Server.spec.slo, docs/observability.md):
+# status True while any objective is violated by the scraped fleet
+# telemetry — the autoscaler's scale-out trigger. Net-new vs the
+# reference, which has no telemetry to evaluate against.
+SLO_VIOLATED = "SLOViolated"
 
 # Reasons
 REASON_AWAITING_UPLOAD = "AwaitingUpload"
@@ -43,3 +48,11 @@ REASON_SLICE_RUNNING = "PodSliceRunning"
 # spec.params validation failed (e.g. quantize outside none|int8|int4) —
 # terminal until the spec changes, like the reference's webhook rejections.
 REASON_INVALID_PARAMS = "InvalidParams"
+# SLOViolated reasons: the violated objective by name (the condition
+# message carries measured-vs-target for every violated objective), or
+# the healthy/empty states.
+REASON_SLO_TTFT = "TTFTP99AboveTarget"
+REASON_SLO_QUEUE_WAIT = "QueueWaitP90AboveTarget"
+REASON_SLO_ERROR_RATE = "ErrorRateAboveTarget"
+REASON_SLO_MET = "AllObjectivesMet"
+REASON_SLO_NO_DATA = "NoTelemetry"

@@ -133,6 +133,36 @@ def print_table(rows: List[List[str]], header: List[str]) -> None:
         print(fmt(row))
 
 
+def telemetry_summary(obj: dict) -> str:
+    """Compact one-cell rendering of .status.telemetry (fleet scraper;
+    docs/observability.md): live load for Servers, training progress for
+    Models."""
+    t = ko.deep_get(obj, "status", "telemetry", default=None)
+    if not isinstance(t, dict) or not t:
+        return ""
+    parts = []
+    if "step" in t:
+        parts.append(f"step={t['step']}")
+        if "loss" in t:
+            parts.append(f"loss={t['loss']}")
+        if "goodput" in t:
+            parts.append(f"goodput={t['goodput']}")
+    else:
+        if "activeSlots" in t:
+            parts.append(f"slots={t['activeSlots']}")
+        if "queueDepth" in t:
+            parts.append(f"queue={t['queueDepth']}")
+        if "queueWaitP90Ms" in t:
+            parts.append(f"qw90={t['queueWaitP90Ms']}ms")
+        if "ttftP99Ms" in t:
+            parts.append(f"ttft99={t['ttftP99Ms']}ms")
+        if "tokensPerSec" in t:
+            parts.append(f"tok/s={t['tokensPerSec']}")
+    if "replicasUp" in t and "replicas" in t:
+        parts.append(f"up={t['replicasUp']}/{t['replicas']}")
+    return " ".join(parts)
+
+
 def condition_summary(obj: dict) -> str:
     conds = ko.deep_get(obj, "status", "conditions", default=[]) or []
     parts = []
@@ -217,7 +247,8 @@ def _collect_rows(client, kind_filter, name_filter, namespace):
                 continue
             ready = "True" if ko.deep_get(obj, "status", "ready") else "False"
             rows.append([f"{kind.lower()}s/{ko.name(obj)}",
-                         ko.namespace(obj), ready, condition_summary(obj)])
+                         ko.namespace(obj), ready, condition_summary(obj),
+                         telemetry_summary(obj)])
     return rows
 
 
@@ -229,7 +260,7 @@ def cmd_get(args) -> int:
 
         return run_flow(GetFlow(client, args.namespace,
                                 kind_filter or "", name_filter or ""))
-    header = ["NAME", "NAMESPACE", "READY", "CONDITIONS"]
+    header = ["NAME", "NAMESPACE", "READY", "CONDITIONS", "TELEMETRY"]
     if not args.watch:
         rows = _collect_rows(client, kind_filter, name_filter,
                              args.namespace)
@@ -249,7 +280,7 @@ def cmd_get(args) -> int:
             if snapshot != last:
                 sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
                 print(time.strftime("%H:%M:%S"), "(watching — ctrl-c to exit)")
-                print_table(rows or [["(none)", "", "", ""]], header)
+                print_table(rows or [["(none)", "", "", "", ""]], header)
                 last = snapshot
             time.sleep(1.0)
     except KeyboardInterrupt:
@@ -597,6 +628,180 @@ def cmd_profile(args) -> int:
             pf.stop()
 
 
+def _fetch_exposition(url: str) -> str:
+    target = url if url.endswith("/metrics") else url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(target, timeout=10) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+def _metric_value(families, name: str, sel: dict, default=None):
+    """First sample of `name` whose labelset includes `sel` (mirrored
+    fleet series carry extra labels like namespace — subset match)."""
+    fam = families.get(name)
+    if fam is None:
+        return default
+    match = set(sel.items())
+    for lkey, value in sorted(fam.samples.items()):
+        if match <= set(lkey):
+            return value
+    return default
+
+
+def _metric_quantile_ms(families, name: str, q: float, sel: dict):
+    """Quantile (ms) over the merged histogram labelsets matching `sel`."""
+    fam = families.get(name)
+    if fam is None:
+        return None
+    merged = None
+    match = set(sel.items())
+    for lkey, hist in sorted(fam.histograms.items()):
+        if match <= set(lkey):
+            merged = hist if merged is None else merged.merged(hist)
+    if merged is None or not merged.count:
+        return None
+    return merged.quantile(q) * 1000.0
+
+
+def _top_rows_from_metrics(text: str):
+    """(header, rows) for `rbt top` from any /metrics body. A controller
+    exposition (fleet_scrape_up present) yields one row per scraped
+    replica; a single replica's own endpoint yields one local row."""
+    from runbooks_tpu.obs.metrics import parse_exposition
+
+    families = parse_exposition(text)
+    header = ["WORKLOAD", "REPLICA", "UP", "AGE", "SLO", "DETAIL"]
+    rows = []
+    up_fam = families.get("fleet_scrape_up")
+    if up_fam is not None and up_fam.samples:
+        for lkey, up in sorted(up_fam.samples.items()):
+            lbl = dict(lkey)
+            kind = lbl.get("kind", "?")
+            name = lbl.get("name", "?")
+            # Namespace included: same-named Servers in two namespaces
+            # must not blend each other's series in the subset match.
+            sel = {"kind": kind, "name": name,
+                   "namespace": lbl.get("namespace", "?"),
+                   "replica": lbl.get("replica", "?")}
+            age = _metric_value(families, "fleet_scrape_age_seconds", sel)
+            slo = _metric_value(families, "fleet_slo_violated",
+                                {"kind": kind, "name": name,
+                                 "namespace": lbl.get("namespace", "?")})
+            rows.append([
+                f"{kind.lower()}s/{name}", sel["replica"],
+                "yes" if up else "NO",
+                f"{age:.0f}s" if age is not None else "-",
+                ("VIOLATED" if slo else "ok") if slo is not None else "-",
+                _top_detail(families, kind, sel) or "-"])
+        return header, rows
+    # Direct replica endpoint (e.g. `rbt top servers/x` port-forward):
+    # one row from the process's own unlabeled series.
+    detail = _top_detail(families, "Server", {}) \
+        or _top_detail(families, "Model", {})
+    rows.append(["local", "-", "yes", "0s", "-", detail or "-"])
+    return header, rows
+
+
+def _top_detail(families, kind: str, sel: dict) -> str:
+    parts = []
+    if kind == "Server":
+        slots = _metric_value(families, "serve_active_slots", sel)
+        queue = _metric_value(families, "serve_queue_depth", sel)
+        qw = _metric_quantile_ms(families, "serve_queue_wait_seconds",
+                                 0.90, sel)
+        ttft = _metric_quantile_ms(families, "serve_ttft_seconds",
+                                   0.99, sel)
+        tps = _metric_value(families, "fleet_tokens_per_sec", sel)
+        if slots is not None:
+            parts.append(f"slots={slots:.0f}")
+        if queue is not None:
+            parts.append(f"queue={queue:.0f}")
+        if qw is not None:
+            parts.append(f"qw90={qw:.1f}ms")
+        if ttft is not None:
+            parts.append(f"ttft99={ttft:.1f}ms")
+        if tps is not None:
+            parts.append(f"tok/s={tps:g}")
+    else:
+        step = _metric_value(families, "train_step", sel)
+        loss = _metric_value(families, "train_loss", sel)
+        goodput = _metric_value(families, "train_goodput_ratio", sel)
+        if step is not None:
+            parts.append(f"step={step:.0f}")
+        if loss is not None:
+            parts.append(f"loss={loss:.4g}")
+        if goodput is not None:
+            parts.append(f"goodput={goodput:g}")
+    return " ".join(parts)
+
+
+def _top_rows_from_crds(client, namespace, kind_filter, name_filter):
+    """(header, rows) from CRD status alone (no /metrics reachable):
+    .status.telemetry + the SLOViolated condition, as the controller's
+    fleet layer last wrote them."""
+    header = ["WORKLOAD", "READY", "SLO", "TELEMETRY"]
+    rows = []
+    for kind in ("Server", "Model"):
+        if kind_filter and kind != kind_filter:
+            continue
+        for obj in client.list(API_VERSION, kind, namespace=namespace):
+            if name_filter and ko.name(obj) != name_filter:
+                continue
+            slo_c = ko.get_condition(obj, "SLOViolated")
+            slo = ("-" if slo_c is None else
+                   "VIOLATED" if slo_c.get("status") == "True" else "ok")
+            rows.append([
+                f"{kind.lower()}s/{ko.name(obj)}",
+                "True" if ko.deep_get(obj, "status", "ready") else "False",
+                slo, telemetry_summary(obj) or "-"])
+    return header, rows
+
+
+def cmd_top(args) -> int:
+    """Live per-replica fleet load + SLO view (docs/observability.md).
+    Sources, in order: --url (any /metrics endpoint — the controller's
+    for the whole fleet), servers/<name> (port-forward to one replica,
+    same plumbing as `rbt chat`), or the CRD .status.telemetry the
+    controller aggregates."""
+    pf = None
+    client = None
+    url = args.url
+    kind_filter = name_filter = None
+    if not url and args.scope:
+        url, pf = _resolve_server_url(
+            args, "usage: rbt top [servers/<name>] [--url URL]")
+    elif not url:
+        client = make_client(args)
+    try:
+        while True:
+            if url:
+                try:
+                    header, rows = _top_rows_from_metrics(
+                        _fetch_exposition(url))
+                except OSError as e:
+                    if args.once:
+                        print(f"top: metrics fetch failed: {e}",
+                              file=sys.stderr)
+                        return 1
+                    header, rows = ["WORKLOAD"], []
+            else:
+                header, rows = _top_rows_from_crds(
+                    client, args.namespace, kind_filter, name_filter)
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                print(time.strftime("%H:%M:%S"),
+                      "fleet top (ctrl-c to exit)")
+            print_table(rows or [["(none)"] + [""] * (len(header) - 1)],
+                        header)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if pf is not None:
+            pf.stop()
+
+
 def cmd_logs(args) -> int:
     """Stream logs of an object's workload pods (the reference TUI streams
     these inline — internal/tui/pods.go; here it shells to kubectl with the
@@ -774,6 +979,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="capture window (default 5)")
     sp.add_argument("--timeout", type=float, default=720.0)
     sp.set_defaults(func=cmd_profile)
+
+    sp = sub.add_parser("top",
+                        help="live per-replica fleet load + SLO view")
+    sp.add_argument("scope", nargs="?", default="",
+                    help="servers/<name> to port-forward one replica")
+    sp.add_argument("--url",
+                    help="a /metrics endpoint (the controller's for the "
+                         "fleet view; skips port-forward)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval seconds (default 2)")
+    sp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    sp.add_argument("--timeout", type=float, default=720.0)
+    sp.set_defaults(func=cmd_top)
 
     sp = sub.add_parser("logs", help="stream workload pod logs")
     sp.add_argument("scope")

@@ -121,6 +121,33 @@ FLOAT_PARAMS = {
 }
 
 
+# Server.spec.slo objectives (docs/observability.md): each is a positive
+# number; the Server reconciler evaluates them every reconcile against the
+# fleet scraper's per-replica telemetry and flips the SLOViolated
+# condition. Validated like the params knobs — a typo'd objective name
+# would otherwise silently never trip.
+SLO_OBJECTIVES = ("ttftP99Ms", "queueWaitP90Ms", "errorRatePct")
+
+
+def validate_slo(slo) -> Optional[str]:
+    """First validation error in a Server spec.slo block, or None."""
+    if slo is None:
+        return None
+    if not isinstance(slo, dict):
+        return "spec.slo: must be a mapping of objective -> target"
+    for key, val in slo.items():
+        if key not in SLO_OBJECTIVES:
+            return (f"spec.slo.{key}: unknown objective (expected one of "
+                    f"{'|'.join(SLO_OBJECTIVES)})")
+        try:
+            num = float(val)
+        except (TypeError, ValueError):
+            return f"spec.slo.{key}: {val!r} is not a number"
+        if num <= 0:
+            return f"spec.slo.{key}: {val} must be > 0"
+    return None
+
+
 def resolve_preemption_restarts(params: dict,
                                 default: int = DEFAULT_PREEMPTION_RESTARTS,
                                 ) -> int:

@@ -1,0 +1,375 @@
+"""Fleet telemetry: the controller scrapes every workload's /metrics.
+
+PR-5 gave each process its own telemetry surface; this layer makes N of
+them observable as one system. A poll loop beside the manager's watch
+loop discovers every Server replica pod (labels ``server=<name>,
+role=run``) and every training Job pod (``model=<name>, role=run``),
+scrapes its Prometheus exposition over the pod IP, and
+
+- mirrors the interesting families (``serve_*``/``train_*``, histograms
+  included) into the controller registry with ``{kind, namespace, name,
+  replica}`` labels — the controller's ``/metrics`` becomes the single
+  fleet scrape point;
+- keeps per-replica freshness/liveness gauges (``fleet_scrape_up``,
+  ``fleet_scrape_age_seconds``) so a dead replica is a visible series,
+  not a silent absence;
+- feeds the in-process :data:`FLEET` state the reconcilers read: the
+  Server reconciler evaluates ``spec.slo`` against it and writes
+  ``.status.telemetry`` (active slots, queue-wait p90, TTFT p99, tok/s),
+  the Model reconciler writes step/loss/goodput.
+
+This is exactly the per-replica load/SLO telemetry the ROADMAP's router
+and autoscaler consume ("live load from each replica's /metrics",
+"sustained queue-wait p90") and that ParvaGPU-style inference-density
+scheduling assumes (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import traceback
+import urllib.request
+from typing import Dict, List, Optional, Set, Tuple
+
+from runbooks_tpu.api.types import API_VERSION
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.obs import metrics as obs_metrics
+
+# (kind, pod label selector key) pairs the scraper discovers.
+SCRAPE_KINDS: Tuple[Tuple[str, str], ...] = (("Server", "server"),
+                                             ("Model", "model"))
+
+# Families worth re-exposing per replica. controller_* and fleet_* stay
+# out on purpose: a controller scraping its own exposition (or another
+# controller's) must not mirror mirrors.
+MIRROR_PREFIXES = ("serve_", "train_")
+
+METRICS_PORT_ANNOTATION = "runbooks-tpu.dev/metrics-port"
+DEFAULT_METRICS_PORT = 8080
+DEFAULT_INTERVAL_S = 10.0
+
+WorkloadKey = Tuple[str, str, str]  # kind, namespace, name
+
+
+@dataclasses.dataclass
+class ReplicaSample:
+    """Last scrape state of one workload pod. ``families`` holds the last
+    SUCCESSFUL scrape's parsed exposition (kept through down periods so
+    `last known` telemetry stays inspectable); ``up`` is the latest
+    attempt's outcome."""
+    replica: str
+    up: bool = False
+    families: Dict[str, obs_metrics.ParsedFamily] = \
+        dataclasses.field(default_factory=dict)
+    last_success: Optional[float] = None   # monotonic
+    tokens_total: Optional[float] = None   # previous counter, for the rate
+    tokens_per_sec: float = 0.0
+
+
+class FleetState:
+    """Thread-safe store of the latest per-replica samples, keyed by
+    workload. Written by the scraper thread, read by reconcilers."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._workloads: Dict[WorkloadKey, Dict[str, ReplicaSample]] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._workloads.clear()
+
+    def get_sample(self, key: WorkloadKey,
+                   replica: str) -> Optional[ReplicaSample]:
+        with self._lock:
+            return self._workloads.get(key, {}).get(replica)
+
+    def update(self, key: WorkloadKey, sample: ReplicaSample) -> None:
+        with self._lock:
+            self._workloads.setdefault(key, {})[sample.replica] = sample
+
+    def prune(self, live: Set[Tuple[WorkloadKey, str]]) -> List[str]:
+        """Drop replicas (and emptied workloads) not in `live`; returns
+        the dropped replica pod names so the caller can drop their
+        mirrored registry series."""
+        dropped: List[str] = []
+        with self._lock:
+            for key in list(self._workloads):
+                reps = self._workloads[key]
+                for rep in list(reps):
+                    if (key, rep) not in live:
+                        del reps[rep]
+                        dropped.append(rep)
+                if not reps:
+                    del self._workloads[key]
+        return dropped
+
+    def replicas(self, kind: str, namespace: str,
+                 name: str) -> Dict[str, ReplicaSample]:
+        with self._lock:
+            return dict(self._workloads.get((kind, namespace, name), {}))
+
+    # -- aggregation (what .status.telemetry and spec.slo consume) ------
+
+    def server_summary(self, namespace: str, name: str) -> Optional[dict]:
+        """Cross-replica load summary for a Server, or None when no
+        replica has ever been scraped. Histograms merge across replicas
+        (same bucket bounds) before the quantile estimate."""
+        reps = self.replicas("Server", namespace, name)
+        if not reps:
+            return None
+        up = [s for s in reps.values() if s.up]
+        out = {"replicas": len(reps), "replicasUp": len(up)}
+        if not up:
+            return out
+
+        def total(fname: str) -> float:
+            return sum(s.families[fname].total() for s in up
+                       if fname in s.families)
+
+        def quantile_ms(fname: str, q: float) -> Optional[float]:
+            merged = None
+            for s in up:
+                fam = s.families.get(fname)
+                hist = fam.merged_histogram() if fam else None
+                if hist is not None:
+                    merged = hist if merged is None else merged.merged(hist)
+            if merged is None or not merged.count:
+                return None
+            return round(merged.quantile(q) * 1000.0, 1)
+
+        out["activeSlots"] = int(total("serve_active_slots"))
+        out["queueDepth"] = int(total("serve_queue_depth"))
+        out["tokensPerSec"] = round(sum(s.tokens_per_sec for s in up), 1)
+        requests = total("serve_requests_total")
+        out["requestsTotal"] = int(requests)
+        if requests > 0:
+            out["errorRatePct"] = round(
+                total("serve_requests_failed_total") / requests * 100.0, 2)
+        qw = quantile_ms("serve_queue_wait_seconds", 0.90)
+        if qw is not None:
+            out["queueWaitP90Ms"] = qw
+        ttft = quantile_ms("serve_ttft_seconds", 0.99)
+        if ttft is not None:
+            out["ttftP99Ms"] = ttft
+        return out
+
+    def model_summary(self, namespace: str, name: str) -> Optional[dict]:
+        """Training progress summary for a Model: step/loss/goodput from
+        the furthest-along replica (the coordinator on multi-host
+        slices), or None when nothing has been scraped."""
+        reps = self.replicas("Model", namespace, name)
+        if not reps:
+            return None
+        up = [s for s in reps.values() if s.up]
+        out = {"replicas": len(reps), "replicasUp": len(up)}
+        best = None
+        best_step = -1.0
+        for s in up:
+            fam = s.families.get("train_step")
+            if fam is None or not fam.samples:
+                continue
+            step = max(fam.samples.values())
+            if step > best_step:
+                best, best_step = s, step
+        if best is not None:
+            out["step"] = int(best_step)
+            loss = best.families.get("train_loss")
+            if loss is not None and loss.samples:
+                out["loss"] = round(next(iter(loss.samples.values())), 4)
+            goodput = best.families.get("train_goodput_ratio")
+            if goodput is not None and goodput.samples:
+                out["goodput"] = round(
+                    next(iter(goodput.samples.values())), 4)
+        return out
+
+
+# The process-wide fleet state: the manager's scraper writes, the Server/
+# Model reconcilers read (same pattern as the shared metrics REGISTRY).
+FLEET = FleetState()
+
+
+class FleetScraper:
+    """Scrapes every workload pod's /metrics into FLEET + the registry.
+
+    ``scrape_once`` is synchronous and exception-safe per replica (one
+    unreachable pod marks its series down; it cannot fail the sweep) —
+    tests drive it directly; ``run`` is the manager's poll loop."""
+
+    def __init__(self, ctx, state: Optional[FleetState] = None,
+                 registry: Optional[obs_metrics.Registry] = None,
+                 timeout_s: float = 2.0):
+        self.ctx = ctx
+        self.state = state if state is not None else FLEET
+        self.registry = (registry if registry is not None
+                         else obs_metrics.REGISTRY)
+        self.timeout_s = timeout_s
+
+    # -- discovery ------------------------------------------------------
+
+    def _pod_url(self, pod: dict) -> Optional[str]:
+        ip = ko.deep_get(pod, "status", "podIP")
+        if not ip:
+            return None
+        port = ko.annotations(pod).get(METRICS_PORT_ANNOTATION)
+        if port is None:
+            # Named container port: the serve Deployment exposes
+            # "http-serve" (metrics live on the serving port), train Jobs
+            # expose "metrics" (RBT_METRICS_PORT).
+            for container in ko.deep_get(pod, "spec", "containers",
+                                         default=[]) or []:
+                for p in container.get("ports", []) or []:
+                    if p.get("name") in ("metrics", "http-serve"):
+                        port = p.get("containerPort")
+                        break
+                if port is not None:
+                    break
+        try:
+            port = int(port) if port is not None else DEFAULT_METRICS_PORT
+        except (TypeError, ValueError):
+            port = DEFAULT_METRICS_PORT
+        return f"http://{ip}:{port}/metrics"
+
+    def _discover(self) -> List[Tuple[WorkloadKey, dict]]:
+        out: List[Tuple[WorkloadKey, dict]] = []
+        for kind, label in SCRAPE_KINDS:
+            for obj in self.ctx.client.list(API_VERSION, kind):
+                ns, name = ko.namespace(obj), ko.name(obj)
+                for pod in self.ctx.client.list(
+                        "v1", "Pod", namespace=ns,
+                        label_selector={label: name, "role": "run"}):
+                    phase = ko.deep_get(pod, "status", "phase", default="")
+                    if phase == "Running":
+                        out.append(((kind, ns, name), pod))
+        return out
+
+    # -- scrape + mirror ------------------------------------------------
+
+    def scrape_once(self) -> int:
+        """One sweep over every running workload pod. Returns the number
+        of replicas scraped successfully."""
+        t0 = time.perf_counter()
+        live: Set[Tuple[WorkloadKey, str]] = set()
+        ok = 0
+        for key, pod in self._discover():
+            live.add((key, ko.name(pod)))
+            if self._scrape_replica(key, pod):
+                ok += 1
+        for replica in self.state.prune(live):
+            # A vanished pod's mirrored absolute series would read as
+            # live forever; drop everything carrying its replica label.
+            self.registry.drop_series(replica=replica)
+        self.registry.observe(
+            "controller_fleet_scrape_seconds", time.perf_counter() - t0,
+            help_text="Wall time of one fleet /metrics sweep across all "
+                      "workload pods.")
+        return ok
+
+    def _scrape_replica(self, key: WorkloadKey, pod: dict) -> bool:
+        kind, ns, name = key
+        replica = ko.name(pod)
+        prev = self.state.get_sample(key, replica)
+        url = self._pod_url(pod)
+        text = None
+        if url is not None:
+            try:
+                with urllib.request.urlopen(url,
+                                            timeout=self.timeout_s) as resp:
+                    text = resp.read().decode("utf-8", "replace")
+            except (OSError, ValueError):
+                text = None
+        now = time.monotonic()
+        labels = {"kind": kind, "namespace": ns, "name": name,
+                  "replica": replica}
+        if text is None:
+            if prev is not None and prev.up:
+                print(f"fleet: scrape of {kind.lower()}s/{name} pod "
+                      f"{replica} failed ({url}); marking down", flush=True)
+            sample = (dataclasses.replace(prev, up=False, tokens_per_sec=0.0)
+                      if prev is not None else ReplicaSample(replica))
+            self.state.update(key, sample)
+            self.registry.set_gauge(
+                "fleet_scrape_up", 0,
+                help_text="1 while the replica's last /metrics scrape "
+                          "succeeded.", **labels)
+            if sample.last_success is not None:
+                self.registry.set_gauge(
+                    "fleet_scrape_age_seconds",
+                    round(now - sample.last_success, 1),
+                    help_text="Seconds since the replica's last "
+                              "successful scrape.", **labels)
+            if kind == "Server":
+                # A hung replica generates nothing; leaving the last
+                # rate on the gauge would show a dead pod still serving.
+                self.registry.set_gauge("fleet_tokens_per_sec", 0.0,
+                                        **labels)
+            return False
+
+        families = obs_metrics.parse_exposition(text)
+        tokens_total = None
+        tokens_per_sec = 0.0
+        tok_fam = families.get("serve_tokens_generated_total")
+        if tok_fam is not None:
+            tokens_total = tok_fam.total()
+            if (prev is not None and prev.tokens_total is not None
+                    and prev.last_success is not None):
+                dt = now - prev.last_success
+                delta = tokens_total - prev.tokens_total
+                if dt > 0 and delta >= 0:  # counter reset -> skip one rate
+                    tokens_per_sec = delta / dt
+        self.state.update(key, ReplicaSample(
+            replica=replica, up=True, families=families, last_success=now,
+            tokens_total=tokens_total, tokens_per_sec=tokens_per_sec))
+        self._mirror(families, labels)
+        self.registry.set_gauge("fleet_scrape_up", 1, **labels)
+        self.registry.set_gauge("fleet_scrape_age_seconds", 0.0, **labels)
+        if kind == "Server":
+            self.registry.set_gauge(
+                "fleet_tokens_per_sec", round(tokens_per_sec, 1),
+                help_text="Completion tokens/s per replica over the last "
+                          "scrape interval.", **labels)
+        return True
+
+    def _mirror(self, families: Dict[str, obs_metrics.ParsedFamily],
+                extra: Dict[str, str]) -> None:
+        """Re-expose a replica's serve_*/train_* families under the
+        controller registry with {kind, namespace, name, replica} labels.
+        Counters and gauges mirror as absolute values (set_counter /
+        set_gauge); histograms mirror bucket-exactly (set_histogram), so
+        PromQL over the controller endpoint sees the same distributions
+        a direct replica scrape would."""
+        for fam in families.values():
+            if not fam.name.startswith(MIRROR_PREFIXES):
+                continue
+            if fam.type in ("counter", "gauge", "untyped"):
+                setter = (self.registry.set_counter
+                          if fam.type == "counter"
+                          else self.registry.set_gauge)
+                for lkey, value in fam.samples.items():
+                    # Dict-merge, extra last: a scraped series may itself
+                    # carry kind/replica labels (a process sharing its
+                    # registry with a controller, or one controller
+                    # scraping another) — the scraped pod's identity wins
+                    # instead of a duplicate-kwarg crash killing the sweep.
+                    setter(fam.name, value, **{**dict(lkey), **extra})
+            elif fam.type == "histogram":
+                for lkey, hist in fam.histograms.items():
+                    self.registry.set_histogram(
+                        fam.name, hist.bounds, hist.cumulative,
+                        hist.count, hist.sum, **{**dict(lkey), **extra})
+
+    # -- poll loop (manager side) --------------------------------------
+
+    def run(self, stop: threading.Event,
+            interval_s: float = DEFAULT_INTERVAL_S) -> None:
+        """Scrape until `stop`; a failing sweep logs and retries — the
+        telemetry plane must never take the control plane with it."""
+        while not stop.is_set():
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                print("fleet: scrape sweep failed (will retry):",
+                      flush=True)
+                traceback.print_exc()
+            stop.wait(interval_s)

@@ -11,6 +11,8 @@ Run: ``python -m runbooks_tpu.controller.main``. Env:
                          "fake" for the in-process no-op client)
   CLUSTER_NAME, ARTIFACT_BUCKET_URL, REGISTRY_URL, PRINCIPAL
   HEALTH_PORT            readiness/liveness HTTP (default 8081)
+  FLEET_SCRAPE_SECONDS   fleet telemetry poll interval (default 10;
+                         0 disables — controller/fleet.py)
   STANDALONE=1           use the in-memory fake cluster (demo/smoke)
 """
 

@@ -157,7 +157,8 @@ class Manager:
     # -- watch-driven loop (deployment path) ---------------------------
 
     def run(self, stop: threading.Event, resync_seconds: float = 30.0,
-            max_backoff: float = 30.0, crash_after: int = 3) -> None:
+            max_backoff: float = 30.0, crash_after: int = 3,
+            fleet_scrape_seconds: Optional[float] = None) -> None:
         """Watch-driven loop. Survives apiserver failure: a CONNECTIVITY-
         shaped error (refused/reset connections on watch, GET, or dependent
         LIST — OSError/ConnectionError/http) logs, backs off exponentially,
@@ -173,7 +174,29 @@ class Manager:
         r5). The stop event is honored both in the healthy sleep and the
         failure backoff, and close_subs JOINS the wire readers so no
         watcher thread outlives the loop (the `watch X: reconnecting`
-        prints after pytest teardown)."""
+        prints after pytest teardown).
+
+        fleet_scrape_seconds: interval of the fleet telemetry poll loop
+        (controller/fleet.py) run alongside the watches; None reads
+        FLEET_SCRAPE_SECONDS (default 10), <= 0 disables."""
+        import os
+
+        if fleet_scrape_seconds is None:
+            try:
+                fleet_scrape_seconds = float(
+                    os.environ.get("FLEET_SCRAPE_SECONDS", "10") or 0)
+            except ValueError:
+                fleet_scrape_seconds = 10.0
+        scrape_thread = None
+        if fleet_scrape_seconds > 0:
+            from runbooks_tpu.controller.fleet import FleetScraper
+
+            scraper = FleetScraper(self.ctx)
+            scrape_thread = threading.Thread(
+                target=scraper.run, args=(stop, fleet_scrape_seconds),
+                daemon=True)
+            scrape_thread.start()
+
         subs: Dict[str, object] = {}
 
         def close_subs(join: bool = False) -> None:
@@ -260,6 +283,8 @@ class Manager:
                 stop.wait(backoff)
                 backoff = min(backoff * 2, max_backoff)
         close_subs(join=True)
+        if scrape_thread is not None:
+            scrape_thread.join(timeout=2.0)
 
     def process_event(self, kind: str, obj: dict,
                       pending: Optional[Dict[tuple, float]] = None) -> None:

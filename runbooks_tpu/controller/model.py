@@ -38,6 +38,10 @@ from runbooks_tpu.k8s import objects as ko
 
 RESTARTS_ANNOTATION = "runbooks-tpu.dev/slice-restarts"
 
+# Trainer metrics exposition port (fleet scraper target; see
+# controller/fleet.py and train/trainer.py main()).
+METRICS_PORT = 8080
+
 
 class ModelReconciler:
     kind = "Model"
@@ -69,6 +73,17 @@ class ModelReconciler:
 
         reconcile_service_account(ctx.client, ctx.cloud, ctx.sci,
                                   SA_MODELLER, model.namespace)
+
+        # Live training telemetry (step/loss/goodput) from the fleet
+        # scraper — `rbt get`/`kubectl get` show progress, not just
+        # readiness. Status-only; written when the aggregate changed.
+        from runbooks_tpu.controller.fleet import FLEET
+
+        telemetry = FLEET.model_summary(model.namespace, model.name)
+        if telemetry is not None \
+                and model.status.get("telemetry") != telemetry:
+            model.status["telemetry"] = telemetry
+            model.commit_status(ctx.client)
 
         # Dependency gates.
         from runbooks_tpu.controller.common import gate_dependency
@@ -220,7 +235,15 @@ class ModelReconciler:
             "name": "model",
             "image": model.image,
             "env": resolve_env(model.env),
+            # Trainer /metrics exposition for the fleet scraper
+            # (controller/fleet.py): the named port is how the scraper
+            # resolves the URL, RBT_METRICS_PORT turns the endpoint on in
+            # train/trainer.py main().
+            "ports": [{"name": "metrics",
+                       "containerPort": METRICS_PORT}],
         }
+        container["env"].append({"name": "RBT_METRICS_PORT",
+                                 "value": str(METRICS_PORT)})
         if model.command:
             container["command"] = list(model.command)
         pod_spec = {

@@ -29,11 +29,16 @@ from runbooks_tpu.controller.common import (
     reconcile_service_account,
     resolve_env,
     validate_params,
+    validate_slo,
 )
 from runbooks_tpu.controller.manager import Ctx, Result
 from runbooks_tpu.k8s import objects as ko
 
 SERVE_PORT = 8080
+
+# How often a Server with spec.slo re-reconciles so the condition tracks
+# fresh scrapes even with no spec/dependency events.
+SLO_REQUEUE_S = 5.0
 
 
 class ServerReconciler:
@@ -43,7 +48,8 @@ class ServerReconciler:
         server = Server(raw)
         if not server.image:
             return Result(requeue_after=1.0)
-        err = validate_params(server.params)
+        err = validate_params(server.params) \
+            or validate_slo(server.spec.get("slo"))
         if err is not None:
             # Invalid spec.params (e.g. quantize: int3): surface a condition
             # instead of shipping a params.json the serve container will
@@ -92,9 +98,97 @@ class ServerReconciler:
         if server.ready != serving:
             server.set_ready(serving)
             changed = True
+        # Fleet telemetry + SLOs (controller/fleet.py): the scrape loop
+        # populates FLEET between reconciles; this pass only folds the
+        # latest aggregate into .status.telemetry and the SLOViolated
+        # condition — no network from the reconciler itself.
+        changed |= self._apply_telemetry_and_slo(server)
         if changed:
             server.commit_status(ctx.client)
-        return Result() if serving else Result(requeue_after=2.0)
+        requeue = None if serving else 2.0
+        if server.spec.get("slo"):
+            requeue = (SLO_REQUEUE_S if requeue is None
+                       else min(requeue, SLO_REQUEUE_S))
+        return Result(requeue_after=requeue)
+
+    # ------------------------------------------------------------------
+
+    def _apply_telemetry_and_slo(self, server: Server) -> bool:
+        from runbooks_tpu.controller.fleet import FLEET
+        from runbooks_tpu.controller.metrics import REGISTRY
+
+        changed = False
+        summary = FLEET.server_summary(server.namespace, server.name)
+        if summary is not None and server.status.get("telemetry") != summary:
+            server.status["telemetry"] = summary
+            changed = True
+
+        slo = server.spec.get("slo") or {}
+        if not slo:
+            return changed
+        violations = self._violations(slo, summary)
+        was_violated = ko.is_condition_true(server.obj, cond.SLO_VIOLATED)
+        if summary is None:
+            changed |= server.set_condition(
+                cond.SLO_VIOLATED, False, cond.REASON_SLO_NO_DATA,
+                "no replica telemetry scraped yet")
+        elif not summary.get("replicasUp"):
+            # Every replica unreachable: HOLD the last verdict. A total
+            # outage must not clear an active violation (the autoscaler/
+            # alert signal would vanish at the worst moment); the
+            # fleet_scrape_up/age gauges carry the outage itself.
+            return changed
+        elif violations:
+            reason, detail = violations[0][0], "; ".join(
+                v[1] for v in violations)
+            changed |= server.set_condition(
+                cond.SLO_VIOLATED, True, reason, detail)
+            if not was_violated:
+                # Counts violation ONSETS (condition False -> True), not
+                # reconciles spent violated — the rate the autoscaler and
+                # alerts want.
+                REGISTRY.inc(
+                    "controller_slo_violations_total",
+                    server=server.name, objective=reason,
+                    help_text="SLOViolated condition onsets, by server "
+                              "and first violated objective.")
+        else:
+            changed |= server.set_condition(
+                cond.SLO_VIOLATED, False, cond.REASON_SLO_MET,
+                "all objectives within target")
+        REGISTRY.set_gauge(
+            "fleet_slo_violated",
+            int(bool(violations)) if summary is not None
+            and summary.get("replicasUp") else 0,
+            kind="Server", namespace=server.namespace, name=server.name,
+            help_text="1 while the Server's SLOViolated condition is "
+                      "true.")
+        return changed
+
+    @staticmethod
+    def _violations(slo: dict, summary) -> list:
+        """(reason, detail) per violated objective, hardest-violated
+        first kept stable by declaration order. Cumulative error rate is
+        used as-is (the counters reset with the replica); the histogram
+        quantiles come from the merged cross-replica distributions."""
+        if not summary:
+            return []
+        out = []
+        checks = (
+            ("ttftP99Ms", "ttftP99Ms", cond.REASON_SLO_TTFT),
+            ("queueWaitP90Ms", "queueWaitP90Ms",
+             cond.REASON_SLO_QUEUE_WAIT),
+            ("errorRatePct", "errorRatePct", cond.REASON_SLO_ERROR_RATE),
+        )
+        for spec_key, summary_key, reason in checks:
+            target = slo.get(spec_key)
+            measured = summary.get(summary_key)
+            if target is None or measured is None:
+                continue
+            if float(measured) > float(target):
+                out.append((reason,
+                            f"{spec_key} {measured} > target {target}"))
+        return out
 
     # ------------------------------------------------------------------
 

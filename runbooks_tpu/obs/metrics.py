@@ -39,6 +39,56 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 LabelKey = Tuple[Tuple[str, str], ...]
 MetricKey = Tuple[str, LabelKey]
 
+# The canonical metric catalog: every family this codebase registers at
+# runtime, by name -> type. tests/test_fleet.py enforces BOTH directions
+# against the docs/observability.md table (a metric added here without a
+# doc row fails, and a doc row for a metric that no longer exists fails),
+# so the catalog cannot silently rot as metrics are added.
+CATALOG: Dict[str, str] = {
+    # controller
+    "controller_reconcile_total": "counter",
+    "controller_reconcile_errors_total": "counter",
+    "controller_reconcile_seconds": "histogram",
+    "controller_apiserver_errors_total": "counter",
+    "controller_slice_restarts_total": "counter",
+    "controller_slo_violations_total": "counter",
+    "controller_fleet_scrape_seconds": "histogram",
+    # fleet scraper (per-replica labels {kind, name, replica}; the serve_*
+    # and train_* families below also appear with these labels on the
+    # controller's exposition, mirrored at scrape time)
+    "fleet_scrape_up": "gauge",
+    "fleet_scrape_age_seconds": "gauge",
+    "fleet_tokens_per_sec": "gauge",
+    "fleet_slo_violated": "gauge",
+    # serve
+    "serve_requests_total": "counter",
+    "serve_requests_failed_total": "counter",
+    "serve_requests_rejected_total": "counter",
+    "serve_tokens_generated_total": "counter",
+    "serve_decode_steps_total": "counter",
+    "serve_deadline_expired_total": "counter",
+    "serve_prefix_tokens_reused_total": "counter",
+    "serve_active_slots": "gauge",
+    "serve_queue_depth": "gauge",
+    "serve_queue_limit": "gauge",
+    "serve_draining": "gauge",
+    "serve_queue_wait_seconds": "histogram",
+    "serve_ttft_seconds": "histogram",
+    "serve_inter_token_seconds": "histogram",
+    "serve_request_duration_seconds": "histogram",
+    "serve_prefill_dispatch_seconds": "histogram",
+    "serve_decode_dispatch_seconds": "histogram",
+    # trainer
+    "train_step_seconds": "histogram",
+    "train_data_wait_seconds": "histogram",
+    "train_checkpoint_seconds": "histogram",
+    "train_goodput_ratio": "gauge",
+    "train_step": "gauge",
+    "train_loss": "gauge",
+    # process
+    "process_uptime_seconds": "gauge",
+}
+
 
 def escape_label_value(value: str) -> str:
     """Escape a label value per the Prometheus text format: backslash,
@@ -172,6 +222,41 @@ class Registry:
             if help_text:
                 self._help.setdefault(name, help_text)
 
+    def set_histogram(self, name: str, bounds: Sequence[float],
+                      cumulative: Sequence[int], count: int, sum_: float,
+                      /, *, help_text: Optional[str] = None,
+                      **labels: str) -> None:
+        """Mirror an externally scraped histogram labelset as absolute
+        state (the fleet scraper re-exposing a replica's distribution).
+        `cumulative` are the finite-bound bucket counts exactly as the
+        exposition carries them; `count` is the +Inf/_count value."""
+        hist = _Histogram(bounds)
+        acc = 0
+        for i, c in enumerate(cumulative):
+            hist.counts[i] = int(c) - acc
+            acc = int(c)
+        hist.sum = float(sum_)
+        hist.count = int(count)
+        with self._lock:
+            self._hists[_key(name, labels)] = hist
+            if help_text:
+                self._help.setdefault(name, help_text)
+
+    def drop_series(self, **labels: str) -> int:
+        """Remove every series whose labelset includes ALL the given
+        label pairs (e.g. ``drop_series(replica=pod)`` when a scraped
+        replica disappears — its mirrored absolute values would otherwise
+        read as live forever). Returns the number of series dropped."""
+        match = {(k, str(v)) for k, v in labels.items()}
+        dropped = 0
+        with self._lock:
+            for store in (self._counters, self._gauges, self._hists):
+                doomed = [k for k in store if match <= set(k[1])]
+                for k in doomed:
+                    del store[k]
+                dropped += len(doomed)
+        return dropped
+
     # -- read side -----------------------------------------------------
 
     def quantile(self, name: str, q: float, /, **labels: str) -> float:
@@ -271,3 +356,146 @@ def serve_metrics(port: int, registry: Optional[Registry] = None) -> HTTPServer:
     httpd = HTTPServer(("0.0.0.0", port), Handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd
+
+
+# ---------------------------------------------------------------------------
+# Exposition parsing (the scrape side of the text format this module
+# renders). The fleet scraper uses it to re-expose each replica's series
+# from the controller; `rbt top` uses it to turn any /metrics body into a
+# table. Stdlib-only for the same reason the renderer is.
+# ---------------------------------------------------------------------------
+
+import re as _re
+
+_SAMPLE_RE = _re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = _re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(value: str) -> str:
+    return (value.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+class ParsedHistogram:
+    """One histogram labelset as scraped: finite-bound cumulative counts
+    + count (+Inf) + sum, with the same quantile estimate the live
+    _Histogram computes."""
+
+    __slots__ = ("bounds", "cumulative", "count", "sum")
+
+    def __init__(self):
+        self.bounds: List[float] = []
+        self.cumulative: List[int] = []
+        self.count = 0
+        self.sum = 0.0
+
+    def quantile(self, q: float) -> float:
+        hist = _Histogram(self.bounds)
+        acc = 0
+        for i, c in enumerate(self.cumulative):
+            hist.counts[i] = int(c) - acc
+            acc = int(c)
+        hist.sum = self.sum
+        hist.count = self.count
+        return hist.quantile(q)
+
+    def merged(self, other: "ParsedHistogram") -> "ParsedHistogram":
+        """Sum with another labelset over the SAME bounds (cross-replica
+        aggregation); mismatched bounds keep self (can't merge buckets)."""
+        if other.bounds != self.bounds:
+            return self
+        out = ParsedHistogram()
+        out.bounds = list(self.bounds)
+        out.cumulative = [a + b for a, b in
+                          zip(self.cumulative, other.cumulative)]
+        out.count = self.count + other.count
+        out.sum = self.sum + other.sum
+        return out
+
+
+class ParsedFamily:
+    """One metric family from a scraped exposition."""
+
+    __slots__ = ("name", "type", "samples", "histograms")
+
+    def __init__(self, name: str, type_: str = "untyped"):
+        self.name = name
+        self.type = type_
+        # counter/gauge: labelset -> value
+        self.samples: Dict[LabelKey, float] = {}
+        # histogram: labelset (without `le`) -> ParsedHistogram
+        self.histograms: Dict[LabelKey, ParsedHistogram] = {}
+
+    def value(self, default: float = 0.0, **labels: str) -> float:
+        return self.samples.get(
+            tuple(sorted((k, str(v)) for k, v in labels.items())), default)
+
+    def total(self) -> float:
+        """Sum across labelsets (cross-replica aggregation of a counter
+        or additive gauge)."""
+        return sum(self.samples.values())
+
+    def merged_histogram(self) -> Optional[ParsedHistogram]:
+        """All labelsets merged into one distribution (same-bounds only)."""
+        out = None
+        for hist in self.histograms.values():
+            out = hist if out is None else out.merged(hist)
+        return out
+
+
+def parse_exposition(text: str) -> Dict[str, ParsedFamily]:
+    """Parse a Prometheus text exposition (the format ``render`` emits,
+    including histograms) into families. Unknown/malformed lines are
+    skipped — a scrape must degrade, not crash the scraper."""
+    families: Dict[str, ParsedFamily] = {}
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+                families.setdefault(parts[2], ParsedFamily(
+                    parts[2], parts[3])).type = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, label_blob, raw = m.group(1), m.group(2), m.group(3)
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        labels = {k: _unescape_label(v)
+                  for k, v in _LABEL_RE.findall(label_blob or "")}
+        # Histogram series fold into their base family.
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            cand = name[: -len(suffix)] if name.endswith(suffix) else None
+            if cand and types.get(cand) == "histogram":
+                base = cand
+                break
+        if base is not None:
+            fam = families.setdefault(base, ParsedFamily(base, "histogram"))
+            le = labels.pop("le", None)
+            lkey = tuple(sorted(labels.items()))
+            hist = fam.histograms.setdefault(lkey, ParsedHistogram())
+            if name.endswith("_bucket"):
+                if le == "+Inf":
+                    hist.count = int(value)
+                elif le is not None:
+                    hist.bounds.append(float(le))
+                    hist.cumulative.append(int(value))
+            elif name.endswith("_sum"):
+                hist.sum = value
+            elif name.endswith("_count"):
+                hist.count = int(value)
+            continue
+        fam = families.setdefault(
+            name, ParsedFamily(name, types.get(name, "untyped")))
+        fam.samples[tuple(sorted(labels.items()))] = value
+    return families

@@ -17,6 +17,12 @@ durable mount); ``configure(path)`` repoints it (the trainer does, per
 run). Writes are lock-serialized line appends, so concurrent spans from
 the engine worker, checkpoint threads, and reconcilers interleave without
 tearing.
+
+Rotation: a long-running traced server would otherwise grow the file
+without bound. When the file exceeds ``RBT_TRACE_MAX_MB`` (default 256)
+it rolls to ``<path>.1`` (one generation kept, the previous ``.1``
+replaced) and a fresh file starts with its own ``[`` header — both
+generations stay independently Perfetto-loadable and line-parseable.
 """
 
 from __future__ import annotations
@@ -49,11 +55,23 @@ class _NullSpan:
 _NULL = _NullSpan()
 
 
+def _max_trace_bytes() -> int:
+    """Rotation threshold from RBT_TRACE_MAX_MB (default 256; fractional
+    values allowed — tests rotate at a few hundred bytes). Read per open,
+    not per write."""
+    try:
+        return int(float(os.environ.get("RBT_TRACE_MAX_MB", "256")) * 2**20)
+    except ValueError:
+        return 256 * 2**20
+
+
 class _Writer:
     def __init__(self):
         self._lock = threading.Lock()
         self._path: Optional[str] = None
         self._file = None
+        self._bytes = 0
+        self._max_bytes = 0
 
     def configure(self, path: Optional[str]) -> None:
         with self._lock:
@@ -86,11 +104,14 @@ class _Writer:
                     self._path = path
                 try:
                     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-                    fresh = (not os.path.exists(path)
-                             or os.path.getsize(path) == 0)
+                    size = (os.path.getsize(path)
+                            if os.path.exists(path) else 0)
                     self._file = open(path, "a", buffering=1)
-                    if fresh:
+                    if size == 0:
                         self._file.write("[\n")
+                        size = 2
+                    self._bytes = size
+                    self._max_bytes = _max_trace_bytes()
                 except OSError:
                     # Tracing must never take down the workload: an
                     # unwritable path drops this event. The CONFIGURED
@@ -101,8 +122,31 @@ class _Writer:
                     return
             try:
                 self._file.write(line + ",\n")
+                self._bytes += len(line) + 2
+                if self._bytes >= self._max_bytes:
+                    self._rotate_locked()
             except OSError:
                 pass
+
+    def _rotate_locked(self) -> None:
+        """Size cap hit: roll the live file to <path>.1 (replacing the
+        previous generation) and start fresh. Caller holds the lock; the
+        open failure mode matches write() — drop and retry later."""
+        path = self._path
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        self._file = None
+        if path is None:
+            return
+        try:
+            os.replace(path, path + ".1")
+            self._file = open(path, "a", buffering=1)
+            self._file.write("[\n")
+            self._bytes = 2
+        except OSError:
+            self._file = None
 
     def close(self) -> None:
         with self._lock:
@@ -172,6 +216,28 @@ def span(name: str, /, **args):
     if not trace_enabled():
         return _NULL
     return _Span(name, args)
+
+
+def complete(name: str, duration_s: float, /, **args) -> None:
+    """Emit a completed span for an interval measured elsewhere, ending
+    now (``ph: "X"`` with ts backdated by the duration). Used for
+    request-scoped phases whose start predates the code that knows their
+    name — e.g. a request's queue wait, measured by the engine at
+    admission time."""
+    if not trace_enabled():
+        return
+    dur = max(float(duration_s), 0.0) * 1e6
+    event = {
+        "name": name,
+        "ph": "X",
+        "ts": round(time.time() * 1e6 - dur, 1),
+        "dur": round(dur, 1),
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0x7FFFFFFF,
+    }
+    if args:
+        event["args"] = args
+    _WRITER.write(event)
 
 
 def instant(name: str, /, **args) -> None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import re
 import threading
 import time
 import uuid
@@ -53,6 +54,41 @@ def _eos_id(tok) -> Optional[int]:
         if val is not None:
             return int(val)
     return None
+
+
+# W3C trace context (https://www.w3.org/TR/trace-context/):
+# version-traceid-parentid-flags, all lowercase hex.
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+# Client-supplied ids flow into response headers, logs, and trace JSON:
+# strip anything that could split a header or forge a log line.
+_RID_UNSAFE_RE = re.compile(r"[^A-Za-z0-9._:/-]")
+
+
+def request_scope(headers) -> Tuple[str, Optional[str]]:
+    """(request_id, traceparent_out) for one HTTP request.
+
+    X-Request-Id is accepted verbatim (sanitized); a W3C ``traceparent``
+    is also honored — its trace-id becomes the request id when no
+    explicit one came, and the response carries a child ``traceparent``
+    (same trace-id, fresh parent-id) so an upstream tracer can stitch
+    the hop. With neither header, an id is generated. The id rides the
+    queue/prefill/decode trace spans (obs/trace.py) and the access log,
+    so one Perfetto trace follows one request across the engine."""
+    rid = headers.get("X-Request-Id") if headers else None
+    tp_out = None
+    tp = (headers.get("traceparent", "") if headers else "").strip().lower()
+    m = _TRACEPARENT_RE.match(tp)
+    if m:
+        tp_out = (f"{m.group(1)}-{m.group(2)}-"
+                  f"{uuid.uuid4().hex[:16]}-{m.group(4)}")
+        if not rid:
+            rid = m.group(2)
+    if rid:
+        rid = _RID_UNSAFE_RE.sub("", str(rid))[:128]
+    if not rid:
+        rid = f"req-{uuid.uuid4().hex[:16]}"
+    return rid, tp_out
 
 
 def load_model(params: dict) -> Tuple[ModelConfig, Any]:
@@ -580,8 +616,9 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 eos_id=eos, deadline_s=deadline))
         return reqs, None
 
-    async def _stream(app_, body, reqs, http_request,
-                      chat: bool = False) -> web.StreamResponse:
+    async def _stream(app_, body, reqs, http_request, chat: bool = False,
+                      rid: str = "", tp_out: Optional[str] = None,
+                      ) -> web.StreamResponse:
         """SSE streaming (OpenAI `stream: true`): one chunk per text delta,
         then a finish chunk per choice, then `data: [DONE]`. The engine's
         on_token hook fires on its worker thread; call_soon_threadsafe
@@ -612,11 +649,16 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             f.add_done_callback(
                 lambda fut, i=i: events.put_nowait(("done", i, fut)))
 
-        resp = web.StreamResponse(headers={
+        headers = {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "X-Accel-Buffering": "no",
-        })
+        }
+        if rid:
+            headers["X-Request-Id"] = rid
+        if tp_out:
+            headers["traceparent"] = tp_out
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(http_request)
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")
@@ -702,9 +744,33 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         return resp
 
     async def _complete(app_, body, http_request=None) -> web.Response:
+        """Request-scope wrapper: resolve/generate the request id, run
+        the completion, stamp the id (and child traceparent) on the
+        response, and emit one access-log line per HTTP request."""
+        rid, tp_out = request_scope(
+            http_request.headers if http_request is not None else {})
+        t0 = time.monotonic()
+        resp = await _complete_scoped(app_, body, http_request, rid, tp_out)
+        if not resp.prepared:  # SSE responses already carry the headers
+            resp.headers["X-Request-Id"] = rid
+            if tp_out:
+                resp.headers["traceparent"] = tp_out
+        path = http_request.path if http_request is not None else "-"
+        print(f"serve: access {path} rid={rid} "
+              f"status={getattr(resp, 'status', 200)} "
+              f"dur_ms={(time.monotonic() - t0) * 1000:.1f}", flush=True)
+        return resp
+
+    async def _complete_scoped(app_, body, http_request, rid,
+                               tp_out) -> web.Response:
         reqs, err = _parse_requests(app_, body)
         if err is not None:
             return err
+        # Thread the id through admission -> engine slot -> prefill/
+        # decode spans; multi-prompt bodies get per-prompt suffixes so
+        # each choice's spans stay distinguishable.
+        for i, r in enumerate(reqs):
+            r.request_id = rid if len(reqs) == 1 else f"{rid}/{i}"
         if auto_prefix_chat and body.get("_chat"):
             # Multi-turn chat: this turn's prompt KV becomes the next
             # turn's prefix (the rendered history strictly extends).
@@ -712,7 +778,8 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 r.auto_prefix = True
         if body.get("stream") and http_request is not None:
             return await _stream(app_, body, reqs, http_request,
-                                 chat=bool(body.pop("_chat", False)))
+                                 chat=bool(body.pop("_chat", False)),
+                                 rid=rid, tp_out=tp_out)
         tok = app_["tokenizer"]
         eos = _eos_id(tok)
         worker = app_["worker"]
@@ -821,7 +888,12 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             "message": {"role": "assistant", "content": c["text"]},
             "finish_reason": c["finish_reason"],
         } for c in payload["choices"]]
-        return web.json_response(payload)
+        out = web.json_response(payload)
+        # Preserve the request scope across the payload rewrite.
+        for header in ("X-Request-Id", "traceparent"):
+            if header in resp.headers:
+                out.headers[header] = resp.headers[header]
+        return out
 
     async def register_prefix(request: web.Request) -> web.Response:
         """Register a shared prompt prefix (e.g. a deployment's chat

@@ -49,6 +49,7 @@ import numpy as np
 from runbooks_tpu.models.config import ModelConfig
 from runbooks_tpu.models.transformer import KVCache, forward
 from runbooks_tpu.obs import metrics as obs_metrics
+from runbooks_tpu.obs.trace import complete as trace_complete
 from runbooks_tpu.obs.trace import span, trace_enabled
 from runbooks_tpu.ops.sampling import sample
 
@@ -102,6 +103,11 @@ class Request:
     # finishes with finish_reason "deadline" and whatever tokens it has —
     # queued requests that expire before admission finish empty-handed.
     deadline_s: Optional[float] = None
+    # Request-scoped trace/correlation id (serve/api.py: accepted or
+    # generated from X-Request-Id / traceparent, echoed in response
+    # headers). Carried into the queue/prefill/decode span args so one
+    # Perfetto trace follows this request end to end.
+    request_id: str = ""
     # Filled by the engine:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
@@ -733,6 +739,12 @@ class InferenceEngine:
                 req._admitted - req._submitted,
                 help_text="Admission-queue wait (submit to slot "
                           "assignment).")
+            if trace_enabled():
+                # The queue phase ends here; backdated complete event so
+                # the request's trace shows queue -> prefill -> decode.
+                trace_complete("queue_wait",
+                               req._admitted - req._submitted,
+                               request_id=req.request_id, slot=slot)
             budget -= need
             admitted.append((slot, req, pkey))
         if not admitted:
@@ -795,7 +807,13 @@ class InferenceEngine:
         # Dispatch timing is host-side, outside jit (the np.asarray pull
         # below is the device sync) — zero effect on compiled programs.
         t_dispatch = time.perf_counter()
-        with span("prefill", bucket=bucket, rows=rows, prefix=plen), \
+        # Request ids only materialize when tracing is on (same rule as
+        # the decode span's active count: no per-dispatch list builds on
+        # the hot path for a disabled tracer).
+        attrs = ({"request_ids": [r.request_id for _, r in group]}
+                 if trace_enabled() else {})
+        with span("prefill", bucket=bucket, rows=rows, prefix=plen,
+                  **attrs), \
                 self._mesh_ctx():
             if pkey:
                 # Admission hit refreshes the LRU position: the prefix
@@ -935,7 +953,10 @@ class InferenceEngine:
         # The active-count span attr is computed only when tracing is on:
         # span() itself is a no-op when off, but eager kwargs would still
         # charge the decode hot loop an array reduction per chunk.
-        attrs = ({"active": int(self.active.sum())}
+        attrs = ({"active": int(self.active.sum()),
+                  "request_ids": [self.slot_req[i].request_id
+                                  for i in range(self.max_slots)
+                                  if self.active[i]]}
                  if trace_enabled() else {})
         with span("decode", view=view, **attrs), self._mesh_ctx():
             toks, valid, self.cache, self.rng = self._decode_for(view)(

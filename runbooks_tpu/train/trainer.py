@@ -588,6 +588,15 @@ def run_training(job: TrainJobConfig,
                             "train_goodput_ratio", entry["goodput"],
                             help_text="Productive step time / wall clock "
                                       "(restart overhead excluded).")
+                    # Progress gauges: what the controller's fleet
+                    # scraper folds into Model .status.telemetry
+                    # (step/loss/goodput on `rbt get`).
+                    REGISTRY.set_gauge(
+                        "train_step", i + 1,
+                        help_text="Last completed training step.")
+                    REGISTRY.set_gauge(
+                        "train_loss", round(loss, 6),
+                        help_text="Loss at the last logged step.")
                     win = {"data": 0.0, "step": 0.0, "ckpt": 0.0,
                            "steps": 0}
                     history.append(entry)
@@ -663,6 +672,16 @@ def exit_code_for(summary: Dict[str, Any]) -> int:
 def main() -> int:
     params = contract.load_params()
     job = TrainJobConfig.from_params(params)
+    # Metrics exposition for the controller's fleet scraper: RBT_METRICS_PORT
+    # (injected by the Model reconciler's Job template) serves the shared
+    # registry — train_step/train_loss/goodput + the step histograms — on
+    # GET /metrics. Env-gated so library callers of run_training never bind
+    # a port.
+    metrics_port = int(os.environ.get("RBT_METRICS_PORT", "0") or 0)
+    if metrics_port:
+        from runbooks_tpu.obs.metrics import serve_metrics
+
+        serve_metrics(metrics_port)
     if job.maintenance_poll_s == 0 and "maintenance_poll_s" not in params:
         # Container entry point on GCE: watch for maintenance events /
         # preemptions by default (a quick single-attempt probe — an off-GCE
