@@ -320,11 +320,129 @@ def obs_inner() -> None:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def device_obs_inner() -> None:
+    """RBT_BENCH_DEVICE_OBS=1: compile discipline + analytic MFU.
+
+    Two assertions about the device layer (docs/observability.md,
+    "Device-level metrics"): (a) the steady-state train step loop runs
+    ZERO unexpected XLA compiles — the compile sentinel is armed after
+    the first (compile-folding) step and any recompile in the measured
+    window is a stall the at-scale papers warn about; the JSON line
+    reports the count and RBT_BENCH_GATE_STRICT=1 exits 4 on a nonzero
+    one. (b) analytic MFU from the compiled step's cost_analysis FLOPs
+    sits beside the formula MFU (3 * model FLOPs/token) the trainer
+    reports — the two must agree to ~10% or one of them is lying
+    (flops_ratio in the JSON line is that cross-check), and the roofline
+    classification (compute- vs bandwidth-bound) rides along."""
+    import jax
+    import jax.numpy as jnp
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.obs import device as obs_device
+    from runbooks_tpu.parallel.mesh import single_device_mesh
+    from runbooks_tpu.train.optimizer import OptimizerConfig, make_optimizer
+    from runbooks_tpu.train.step import create_train_state, make_train_step
+    from runbooks_tpu.utils.hw import chip_peak_flops
+
+    device = jax.devices()[0]
+    on_tpu = ("tpu" in getattr(device, "platform", "").lower()
+              or "TPU" in str(device))
+    if on_tpu:
+        model, batch_size, seq, steps = "bench-410m-d128", 8, 2048, 20
+    else:
+        model, batch_size, seq, steps = "debug", 4, 128, 30
+    model = os.environ.get("RBT_BENCH_MODEL", model)
+    batch_size = int(os.environ.get("RBT_BENCH_BS", batch_size))
+    seq = int(os.environ.get("RBT_BENCH_SEQ", seq))
+
+    cfg = get_config(model)
+    mesh = single_device_mesh()
+    opt = make_optimizer(OptimizerConfig(total_steps=10_000, warmup_steps=10))
+    state, shardings = create_train_state(cfg, opt, mesh, jax.random.key(0))
+    step = make_train_step(cfg, opt, mesh, shardings)
+    tokens = jax.random.randint(jax.random.key(1), (batch_size, seq + 1), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:],
+             "loss_mask": jnp.ones((batch_size, seq), jnp.float32)}
+
+    sentinel = obs_device.SENTINEL
+    # install() returns False when this jax build exposes no monitoring
+    # feed — the sentinel then observes NOTHING, and "0 unexpected
+    # compiles" would be vacuous; the gate must fail loudly, not pass.
+    monitoring_live = sentinel.install()
+    try:
+        with jax.set_mesh(mesh):
+            # Compile + warmup, then arm the sentinel: from here on every
+            # compile in the measured loop is a stall.
+            state, metrics = step(state, batch)
+            float(metrics["loss"])
+            state, metrics = step(state, batch)
+            float(metrics["loss"])
+            cost = obs_device.cost_analysis_of(step, state, batch)
+            sentinel.mark_steady("bench")
+            unexpected_before = sentinel.unexpected
+
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, batch)
+            float(metrics["loss"])
+            dt = time.perf_counter() - t0
+        unexpected = sentinel.unexpected - unexpected_before
+    finally:
+        sentinel.clear_steady("bench")
+
+    step_time_s = dt / steps
+    peak = chip_peak_flops(device) or 1e12  # nominal off-TPU, like inner()
+    formula_flops = 3.0 * cfg.flops_per_token(seq) * batch_size * seq
+    mfu_formula = formula_flops / step_time_s / peak
+    out = {
+        "metric": f"{model} device-obs: unexpected compiles in "
+                  f"{steps}-step steady loop (bs{batch_size}x{seq})",
+        "value": unexpected,
+        "unit": "compiles",
+        # Pass = zero recompiles once steady, OBSERVED by a live feed.
+        "vs_baseline": (1.0 if unexpected == 0 and monitoring_live
+                        else 0.0),
+        "sentinel_monitoring": monitoring_live,
+        "compiles_total": sentinel.total,
+        "step_time_s": round(step_time_s, 5),
+        "mfu_formula": round(mfu_formula, 4),
+        "platform": jax.default_backend(),
+        "device": str(device),
+    }
+    if cost is not None:
+        roof = obs_device.classify_roofline(cost["flops"],
+                                            cost["hbm_bytes"])
+        mfu_analytic = cost["flops"] / step_time_s / peak
+        out.update({
+            "analytic_flops_per_step": cost["flops"],
+            "formula_flops_per_step": formula_flops,
+            # cost_analysis vs the 3x-forward formula: the cross-check.
+            "flops_ratio": round(cost["flops"] / formula_flops, 3),
+            "hbm_bytes_per_step": cost["hbm_bytes"],
+            "mfu_analytic": round(mfu_analytic, 4),
+            "arithmetic_intensity": roof["arithmetic_intensity"],
+            "bound": roof["bound"],
+        })
+    print(json.dumps(out))
+    if os.environ.get("RBT_BENCH_GATE_STRICT") == "1" \
+            and (unexpected or not monitoring_live):
+        print(f"DEVICE-OBS GATE: "
+              + (f"{unexpected} unexpected compile(s) in the "
+                 "steady-state loop" if unexpected else
+                 "jax.monitoring feed unavailable — nothing was "
+                 "observed") + " (strict mode)", file=sys.stderr,
+              flush=True)
+        raise SystemExit(4)
+
+
 def inner() -> None:
     if os.environ.get("RBT_BENCH_RESUME") == "1":
         return resume_inner()
     if os.environ.get("RBT_BENCH_OBS") == "1":
         return obs_inner()
+    if os.environ.get("RBT_BENCH_DEVICE_OBS") == "1":
+        return device_obs_inner()
     import jax
     import jax.numpy as jnp
 

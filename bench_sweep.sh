@@ -127,6 +127,14 @@ run serve-quant-int4 env RBT_BENCH_QUANTIZE=int4 python bench_serve.py
 RBT_BENCH_SKIP_SERVE=1 run train-obs-overhead \
   env RBT_BENCH_OBS=1 python bench.py
 
+# 4b2. Device-level observability (docs/observability.md): zero
+#      unexpected XLA compiles across the steady-state step loop (the
+#      compile sentinel armed after the compile-folding first step;
+#      strict mode exits 4 on any recompile) + analytic cost_analysis
+#      MFU beside the formula MFU (flops_ratio ~ 1 or one is lying).
+RBT_BENCH_SKIP_SERVE=1 run train-device-obs \
+  env RBT_BENCH_DEVICE_OBS=1 RBT_BENCH_GATE_STRICT=1 python bench.py
+
 # 4c. Fleet telemetry smoke (docs/observability.md): the controller
 #     scrape loop against live replica /metrics endpoints end to end —
 #     per-replica mirroring, freshness gauges, merged-histogram summary.

@@ -647,6 +647,42 @@ def _metric_value(families, name: str, sel: dict, default=None):
     return default
 
 
+def _metric_sum(families, name: str, sel: dict, default=None):
+    """Sum of every sample of `name` whose labelset includes `sel` (e.g.
+    per-device HBM gauges summed across a replica's devices)."""
+    fam = families.get(name)
+    if fam is None:
+        return default
+    match = set(sel.items())
+    vals = [v for lkey, v in fam.samples.items() if match <= set(lkey)]
+    return sum(vals) if vals else default
+
+
+def _top_hbm(families, sel: dict) -> str:
+    """HBM% cell: bytes-in-use / limit across the replica's devices
+    (device_memory_* gauges; '-' on CPU replicas, where memory_stats()
+    is absent and the series never exists)."""
+    in_use = _metric_sum(families, "device_memory_bytes_in_use", sel)
+    limit = _metric_sum(families, "device_memory_bytes_limit", sel)
+    if in_use is None or not limit:
+        return "-"
+    return f"{in_use / limit * 100:.0f}%"
+
+
+def _top_slots(families, sel: dict) -> str:
+    """Slot-utilization cell: active/total slots + KV token occupancy
+    (the paged-KV headroom signal; docs/observability.md)."""
+    active = _metric_value(families, "serve_active_slots", sel)
+    total = _metric_value(families, "serve_slots_total", sel)
+    if active is None or not total:
+        return "-"
+    cell = f"{active:.0f}/{total:.0f}"
+    kv = _metric_value(families, "serve_kv_occupancy_ratio", sel)
+    if kv is not None:
+        cell += f" kv={kv * 100:.0f}%"
+    return cell
+
+
 def _metric_quantile_ms(families, name: str, q: float, sel: dict):
     """Quantile (ms) over the merged histogram labelsets matching `sel`."""
     fam = families.get(name)
@@ -669,7 +705,8 @@ def _top_rows_from_metrics(text: str):
     from runbooks_tpu.obs.metrics import parse_exposition
 
     families = parse_exposition(text)
-    header = ["WORKLOAD", "REPLICA", "UP", "AGE", "SLO", "DETAIL"]
+    header = ["WORKLOAD", "REPLICA", "UP", "AGE", "SLO", "HBM", "SLOTS",
+              "DETAIL"]
     rows = []
     up_fam = families.get("fleet_scrape_up")
     if up_fam is not None and up_fam.samples:
@@ -691,13 +728,16 @@ def _top_rows_from_metrics(text: str):
                 "yes" if up else "NO",
                 f"{age:.0f}s" if age is not None else "-",
                 ("VIOLATED" if slo else "ok") if slo is not None else "-",
+                _top_hbm(families, sel),
+                _top_slots(families, sel) if kind == "Server" else "-",
                 _top_detail(families, kind, sel) or "-"])
         return header, rows
     # Direct replica endpoint (e.g. `rbt top servers/x` port-forward):
     # one row from the process's own unlabeled series.
     detail = _top_detail(families, "Server", {}) \
         or _top_detail(families, "Model", {})
-    rows.append(["local", "-", "yes", "0s", "-", detail or "-"])
+    rows.append(["local", "-", "yes", "0s", "-", _top_hbm(families, {}),
+                 _top_slots(families, {}), detail or "-"])
     return header, rows
 
 

@@ -43,8 +43,11 @@ SCRAPE_KINDS: Tuple[Tuple[str, str], ...] = (("Server", "server"),
 
 # Families worth re-exposing per replica. controller_* and fleet_* stay
 # out on purpose: a controller scraping its own exposition (or another
-# controller's) must not mirror mirrors.
-MIRROR_PREFIXES = ("serve_", "train_")
+# controller's) must not mirror mirrors. xla_*/device_* (obs/device.py:
+# compile sentinel, HBM gauges, program roofline) mirror so per-replica
+# HBM headroom and unexpected-compile storms are visible from the single
+# fleet scrape point.
+MIRROR_PREFIXES = ("serve_", "train_", "xla_", "device_")
 
 METRICS_PORT_ANNOTATION = "runbooks-tpu.dev/metrics-port"
 DEFAULT_METRICS_PORT = 8080

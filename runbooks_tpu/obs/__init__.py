@@ -17,6 +17,9 @@ numbers.
   restart/restore-aware (pairs with docs/fault-tolerance.md resume).
 - ``obs.profile``  — on-demand ``jax.profiler`` capture (serve API
   ``POST /debug/profile``; trainer ``RBT_PROFILE_AT_STEP``).
+- ``obs.device``   — device-level: recompilation sentinel
+  (``xla_unexpected_compiles_total``), HBM/live-array accounting,
+  roofline (compute- vs bandwidth-bound) attribution per program.
 
 See docs/observability.md for the metric catalog and how-tos.
 """

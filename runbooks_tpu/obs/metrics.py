@@ -85,6 +85,28 @@ CATALOG: Dict[str, str] = {
     "train_goodput_ratio": "gauge",
     "train_step": "gauge",
     "train_loss": "gauge",
+    "train_analytic_mfu": "gauge",
+    # device-level (obs/device.py): compile sentinel, program census,
+    # roofline attribution, HBM accounting
+    "xla_compilations_total": "counter",
+    "xla_unexpected_compiles_total": "counter",
+    "xla_compile_seconds": "histogram",
+    "xla_programs": "gauge",
+    "xla_program_flops": "gauge",
+    "xla_program_hbm_bytes": "gauge",
+    "xla_program_arithmetic_intensity": "gauge",
+    "xla_program_bandwidth_bound": "gauge",
+    "device_memory_bytes_in_use": "gauge",
+    "device_memory_peak_bytes": "gauge",
+    "device_memory_bytes_limit": "gauge",
+    "device_memory_headroom_bytes": "gauge",
+    # KV-cache occupancy + prefix reuse (paged-KV design baseline)
+    "serve_slots_total": "gauge",
+    "serve_kv_cache_tokens": "gauge",
+    "serve_kv_cache_capacity_tokens": "gauge",
+    "serve_kv_occupancy_ratio": "gauge",
+    "serve_prefix_lookups_total": "counter",
+    "serve_prefix_hits_total": "counter",
     # process
     "process_uptime_seconds": "gauge",
 }
@@ -267,6 +289,14 @@ class Registry:
     def counter_value(self, name: str, /, **labels: str) -> float:
         with self._lock:
             return self._counters.get(_key(name, labels), 0.0)
+
+    def histogram_stats(self, name: str, /,
+                        **labels: str) -> Optional[Tuple[int, float]]:
+        """(count, sum) of one histogram labelset, or None — the mean
+        dispatch time a roofline's analytic MFU divides by."""
+        with self._lock:
+            hist = self._hists.get(_key(name, labels))
+            return (hist.count, hist.sum) if hist is not None else None
 
     def render(self) -> str:
         """Prometheus text format, grouped per family: ``# HELP`` and

@@ -69,6 +69,19 @@ class Profiler:
         from runbooks_tpu.obs import trace as obs_trace
 
         obs_trace.instant("profile.stop", dir=log_dir)
+        # Self-contained bundle: snapshot the device memory state
+        # (memory_stats() + live-array census) beside the XLA trace, so
+        # "what was resident while this trace ran" travels with the
+        # capture instead of needing a live process to ask.
+        try:
+            import json
+
+            from runbooks_tpu.obs import device as obs_device
+
+            with open(os.path.join(log_dir, "memory.json"), "w") as f:
+                json.dump(obs_device.memory_snapshot(), f, indent=2)
+        except Exception as exc:  # noqa: BLE001 — the trace still stands
+            print(f"profile: memory snapshot failed: {exc!r}", flush=True)
         return log_dir
 
     def capture(self, log_dir: str, seconds: float) -> str:

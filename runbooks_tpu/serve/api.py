@@ -403,6 +403,12 @@ class EngineWorker:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=5)
+        # This engine's steady claim ends with its worker: a successor
+        # engine (or any later workload in the process) compiles its own
+        # warmup without being flagged as a serve-time stall. Claims are
+        # refcounted per component, so stopping one of two colocated
+        # servers does not blind the sentinel for the survivor.
+        self.engine.release_steady()
         # Queued prefix jobs the loop never reached must not hang their
         # awaiting HTTP handlers.
         with self._lock:
@@ -520,6 +526,35 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                         eng.prefix_tokens_reused,
                         help_text="Prompt tokens served from the shared-"
                                   "prefix KV cache instead of prefill.")
+        # Device-level families (obs/device.py, docs/observability.md):
+        # KV slot-pool occupancy + prefix hit rate (the paged-KV design
+        # baseline), per-device HBM gauges (absent on CPU), and the
+        # compiled-program census/roofline gauges.
+        from runbooks_tpu.obs import device as obs_device
+
+        occ = eng.kv_occupancy()
+        reg.set_gauge("serve_slots_total", occ["slots_total"],
+                      help_text="Engine slot-pool size (max concurrent "
+                                "decodes).")
+        reg.set_gauge("serve_kv_cache_tokens", occ["kv_tokens"],
+                      help_text="Tokens currently held in active KV "
+                                "slots.")
+        reg.set_gauge("serve_kv_cache_capacity_tokens",
+                      occ["kv_capacity_tokens"],
+                      help_text="Dense KV reservation: max_slots x "
+                                "max_seq_len.")
+        reg.set_gauge("serve_kv_occupancy_ratio",
+                      round(occ["occupancy_ratio"], 6),
+                      help_text="Cached tokens / dense KV reservation "
+                                "(the paged-KV headroom signal).")
+        reg.set_counter("serve_prefix_lookups_total", eng.prefix_lookups,
+                        help_text="Admissions that checked the shared-"
+                                  "prefix cache.")
+        reg.set_counter("serve_prefix_hits_total", eng.prefix_hits,
+                        help_text="Admissions whose prompt matched a "
+                                  "registered prefix.")
+        obs_device.set_memory_gauges(reg)
+        obs_device.PROGRAMS.set_gauges(reg, component="serve")
         body = reg.render().encode("utf-8")
         return web.Response(
             body=body, headers={"Content-Type": obs_metrics.CONTENT_TYPE})
@@ -561,6 +596,77 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 {"error": {"message": f"profile capture failed: {exc}"}},
                 status=500)
         return web.json_response({"path": log_dir, "seconds": seconds})
+
+    async def debug_memory(request: web.Request) -> web.Response:
+        """GET /debug/memory: per-device allocator stats (HBM in use /
+        peak / limit — absent on CPU, where memory_stats() is None) plus
+        the live-array census attributing bytes to weights / KV cache /
+        prefix cache / other. The answer to "what is eating HBM" without
+        waiting for the OOM (docs/observability.md)."""
+        from runbooks_tpu.obs import device as obs_device
+
+        eng = worker.engine
+        try:
+            snap = await asyncio.get_running_loop().run_in_executor(
+                None, obs_device.memory_snapshot, eng.memory_groups())
+        except Exception as exc:  # noqa: BLE001 — diagnostics, not serving
+            return web.json_response(
+                {"error": {"message": f"memory snapshot failed: {exc}"}},
+                status=500)
+        snap["kv_occupancy"] = eng.kv_occupancy()
+        return web.json_response(snap)
+
+    async def debug_programs(request: web.Request) -> web.Response:
+        """GET /debug/programs: the compiled-program census (live XLA
+        variants per jitted entry point) with per-shape roofline
+        attribution — FLOPs, HBM bytes, arithmetic intensity, compute- vs
+        bandwidth-bound — plus analytic MFU for programs with a measured
+        dispatch-time distribution, and the compile-sentinel state."""
+        from runbooks_tpu.obs import device as obs_device
+        from runbooks_tpu.obs import metrics as obs_metrics_mod
+
+        peak_flops, hbm_bps = obs_device.device_peaks()
+        reg = obs_metrics_mod.REGISTRY
+        census = obs_device.PROGRAMS.census("serve")
+        for entry in census:
+            for sig, cost in entry["costs"].items():
+                # Measured mean dispatch for this program family, from
+                # the live histograms, keyed the way the engine labels
+                # them (decode by view, prefill by bucket).
+                stats = None
+                if entry["name"].startswith("decode_v"):
+                    stats = reg.histogram_stats(
+                        "serve_decode_dispatch_seconds",
+                        view=entry["name"][len("decode_v"):])
+                elif entry["name"] == "prefill" and sig.startswith("b"):
+                    bucket, _, rows_sig = sig[1:].partition("r")
+                    stats = reg.histogram_stats(
+                        "serve_prefill_dispatch_seconds", bucket=bucket,
+                        rows=rows_sig)
+                if stats and stats[0]:
+                    mean_s = stats[1] / stats[0]
+                    cost["measured_mean_seconds"] = round(mean_s, 6)
+                    # 9 decimals: tiny test programs against a multi-chip
+                    # peak land around 1e-8 and must not round to 0.
+                    cost["analytic_mfu"] = round(
+                        cost["flops"] / (mean_s * peak_flops), 9)
+                    cost["achieved_gbps"] = round(
+                        cost["hbm_bytes"] / mean_s / 1e9, 3)
+        sentinel = obs_device.SENTINEL
+        return web.json_response({
+            "programs": census,
+            "warmup_census": worker.engine.warmup_census,
+            "compiles": {"total": sentinel.total,
+                         "unexpected": sentinel.unexpected,
+                         "compile_seconds": round(
+                             sentinel.compile_seconds, 3),
+                         "steady": sentinel.steady_components(),
+                         "last_unexpected": sentinel.recent_unexpected()},
+            "peaks": {"flops_per_sec": peak_flops,
+                      "hbm_bytes_per_sec": hbm_bps,
+                      "ridge_flops_per_byte": round(
+                          peak_flops / hbm_bps, 3)},
+        })
 
     async def completions(request: web.Request) -> web.Response:
         try:
@@ -936,6 +1042,8 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/debug/profile", debug_profile)
+    app.router.add_get("/debug/memory", debug_memory)
+    app.router.add_get("/debug/programs", debug_programs)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/prefix", register_prefix)

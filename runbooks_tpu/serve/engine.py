@@ -48,6 +48,7 @@ import numpy as np
 
 from runbooks_tpu.models.config import ModelConfig
 from runbooks_tpu.models.transformer import KVCache, forward
+from runbooks_tpu.obs import device as obs_device
 from runbooks_tpu.obs import metrics as obs_metrics
 from runbooks_tpu.obs.trace import complete as trace_complete
 from runbooks_tpu.obs.trace import span, trace_enabled
@@ -270,6 +271,18 @@ class InferenceEngine:
         # (registration AND admission hits refresh), first key evicts.
         self._prefix_cache: "dict[tuple, tuple]" = {}
         self.prefix_tokens_reused = 0   # observability/tests
+        # Prefix hit rate (docs/observability.md; the baseline number the
+        # paged-KV/radix work must beat): admissions that looked for a
+        # registered prefix vs admissions that found one.
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        # Device-level observability (obs/device.py): every compile after
+        # warmup() is a serve-time stall the sentinel flags; the program
+        # tracker carries the live compiled-variant census + roofline
+        # costs behind /debug/programs and the xla_* gauge families.
+        obs_device.SENTINEL.install()
+        self.warmup_census: Optional[dict] = None
+        self._marked_steady = False  # one steady claim per engine
 
         cache_len = self.max_seq_len + 1
 
@@ -349,6 +362,9 @@ class InferenceEngine:
             lambda params, pool, pk, pv, *rest: prefill_fn(
                 params, pool, *rest, pk=pk, pv=pv),
             donate_argnums=(1,))
+        obs_device.PROGRAMS.register("serve", "prefill", self._prefill)
+        obs_device.PROGRAMS.register("serve", "prefill_prefix",
+                                     self._prefill_prefix)
 
         def prefix_build_fn(params, tokens, positions):
             # Returns the full bucket-width row; the caller slices to the
@@ -367,6 +383,8 @@ class InferenceEngine:
             return c1.k[:, 0], c1.v[:, 0]
 
         self._prefix_build = jax.jit(prefix_build_fn)
+        obs_device.PROGRAMS.register("serve", "prefix_build",
+                                     self._prefix_build)
 
         chunk = self.decode_chunk
         max_len = self.max_seq_len
@@ -418,6 +436,8 @@ class InferenceEngine:
             if view not in self._decode_fns:
                 self._decode_fns[view] = jax.jit(
                     functools.partial(decode_fn, view), donate_argnums=(1,))
+                obs_device.PROGRAMS.register("serve", f"decode_v{view}",
+                                             self._decode_fns[view])
             return self._decode_fns[view]
 
         self._decode_for = decode_for
@@ -461,55 +481,117 @@ class InferenceEngine:
         if rows is None:
             rows = (1, self.max_slots) if self.max_slots > 1 else (1,)
         n_prefix = n_prefill = 0
-        if prefix_build:
-            for bucket in self.prefill_buckets:
-                toks = np.zeros((1, bucket), np.int32)
-                pos = np.full((1, bucket), self._pad_slot, np.int32)
-                pos[0, 0] = 0
-                with self._mesh_ctx():
-                    self._prefix_build(self.params, jnp.asarray(toks),
-                                       jnp.asarray(pos))
-                n_prefix += 1
+        # Roofline cost capture re-traces each shape once (no second
+        # backend compile); RBT_DEVICE_OBS=0 skips it when even that
+        # startup cost matters.
+        import os as _os
+
+        capture_costs = _os.environ.get("RBT_DEVICE_OBS", "1") != "0"
+
+        def record_cost(name, sig, fn, *args):
+            if capture_costs:
+                obs_device.program_cost("serve", name, sig, fn, *args)
+
+        sentinel = obs_device.SENTINEL
+        compiles_before = sentinel.total
+        seconds_before = sentinel.compile_seconds
+        t_warm = time.perf_counter()
         row_set = list(dict.fromkeys(min(r, self.max_slots) for r in rows))
-        for bucket in self.prefill_buckets:
-            for r in row_set:
-                padded = np.zeros((r, bucket), np.int32)
-                positions = np.full((r, bucket), self._pad_slot, np.int32)
-                positions[:, :2] = [0, 1]
+        # Warmup compiles are the intended ones — with another component
+        # already steady in this process (a trainer sharing it, a second
+        # engine) they must not read as stalls.
+        with sentinel.expected():
+            if prefix_build:
+                for bucket in self.prefill_buckets:
+                    toks = np.zeros((1, bucket), np.int32)
+                    pos = np.full((1, bucket), self._pad_slot, np.int32)
+                    pos[0, 0] = 0
+                    with self._mesh_ctx():
+                        self._prefix_build(self.params, jnp.asarray(toks),
+                                           jnp.asarray(pos))
+                    n_prefix += 1
+            for bucket in self.prefill_buckets:
+                for r in row_set:
+                    padded = np.zeros((r, bucket), np.int32)
+                    positions = np.full((r, bucket), self._pad_slot,
+                                        np.int32)
+                    positions[:, :2] = [0, 1]
+                    args = (jnp.asarray(padded), jnp.asarray(positions),
+                            jnp.zeros(r, jnp.int32),
+                            jnp.ones(r, jnp.int32),
+                            jax.random.key(0), jnp.zeros(r, jnp.float32),
+                            jnp.zeros(r, jnp.int32),
+                            jnp.ones(r, jnp.float32))
+                    with self._mesh_ctx():
+                        record_cost("prefill", f"b{bucket}r{r}",
+                                    self._prefill, self.params,
+                                    self.cache, *args)
+                        _, self.cache, _ = self._prefill(
+                            self.params, self.cache, *args)
+                    n_prefill += 1
+            zeros = np.zeros(self.max_slots, np.int32)
+            for view in self.view_buckets:
+                args = (jnp.asarray(zeros),
+                        jnp.asarray(np.full(self.max_slots, self._pad_slot,
+                                            np.int32)),
+                        jax.random.key(0),
+                        jnp.zeros(self.max_slots, jnp.float32),
+                        jnp.zeros(self.max_slots, jnp.int32),
+                        jnp.ones(self.max_slots, jnp.float32),
+                        jnp.full(self.max_slots, -1, jnp.int32),
+                        jnp.zeros(self.max_slots, jnp.int32),
+                        jnp.zeros(self.max_slots, bool))
                 with self._mesh_ctx():
-                    _, self.cache, _ = self._prefill(
-                        self.params, self.cache,
-                        jnp.asarray(padded), jnp.asarray(positions),
-                        jnp.zeros(r, jnp.int32), jnp.ones(r, jnp.int32),
-                        jax.random.key(0), jnp.zeros(r, jnp.float32),
-                        jnp.zeros(r, jnp.int32), jnp.ones(r, jnp.float32))
-                n_prefill += 1
-        zeros = np.zeros(self.max_slots, np.int32)
-        for view in self.view_buckets:
-            with self._mesh_ctx():
-                _, _, self.cache, _ = self._decode_for(view)(
-                    self.params, self.cache, jnp.asarray(zeros),
-                    jnp.asarray(np.full(self.max_slots, self._pad_slot,
-                                        np.int32)),
-                    jax.random.key(0),
-                    jnp.zeros(self.max_slots, jnp.float32),
-                    jnp.zeros(self.max_slots, jnp.int32),
-                    jnp.ones(self.max_slots, jnp.float32),
-                    jnp.full(self.max_slots, -1, jnp.int32),
-                    jnp.zeros(self.max_slots, jnp.int32),
-                    jnp.zeros(self.max_slots, bool))
-        # One-line compiled-program census: model-config variants (e.g.
-        # collective_matmul, quantized tiers) multiply the per-shape
-        # program set, and a silently ballooning warmup is a compile-time
-        # regression nobody notices until readiness stalls — make the
-        # count visible per run.
+                    record_cost(f"decode_v{view}", f"v{view}",
+                                self._decode_for(view), self.params,
+                                self.cache, *args)
+                    _, _, self.cache, _ = self._decode_for(view)(
+                        self.params, self.cache, *args)
+        # Compiled-program census from the tracker (count + names +
+        # compile seconds): model-config variants (collective_matmul,
+        # quantized tiers) multiply the per-shape program set, and a
+        # silently ballooning warmup is a compile-time regression nobody
+        # notices until readiness stalls. The one-line print stays for
+        # grep-ability; the structured dict feeds /debug/programs.
+        census = obs_device.PROGRAMS.census("serve")
+        self.warmup_census = {
+            "prefill_programs": n_prefill,
+            "prefill_buckets": list(self.prefill_buckets),
+            "rows": row_set,
+            "decode_views": list(self.view_buckets),
+            "prefix_builders": n_prefix,
+            "compiles": sentinel.total - compiles_before,
+            "compile_seconds": round(
+                sentinel.compile_seconds - seconds_before, 3),
+            "warmup_seconds": round(time.perf_counter() - t_warm, 3),
+            "programs": [{"name": c["name"], "programs": c["programs"]}
+                         for c in census],
+        }
         print(
             f"serve: warmup census: {n_prefill} prefill programs "
             f"({len(self.prefill_buckets)} buckets {self.prefill_buckets} "
             f"x rows {row_set}), {len(self.view_buckets)} decode views "
-            f"{self.view_buckets}, {n_prefix} prefix builders",
+            f"{self.view_buckets}, {n_prefix} prefix builders; "
+            f"{self.warmup_census['compiles']} compiles in "
+            f"{self.warmup_census['compile_seconds']}s "
+            f"({[(c['name'], c['programs']) for c in census]})",
             flush=True)
+        # From here on, a compile is a serve-time stall: the sentinel
+        # flags it loudly (xla_unexpected_compiles_total). One refcounted
+        # claim per engine, however many times warmup() reruns; the
+        # engine worker releases it at stop().
+        if not self._marked_steady:
+            self._marked_steady = True
+            sentinel.mark_steady("serve")
         self.reset()
+
+    def release_steady(self) -> None:
+        """Release this engine's steady claim (the worker calls it at
+        stop; embedders that warm an engine and discard it should too).
+        Idempotent; pairs exactly with warmup()'s one mark."""
+        if self._marked_steady:
+            self._marked_steady = False
+            obs_device.SENTINEL.clear_steady("serve")
 
     # ------------------------------------------------------------------
     # Request lifecycle
@@ -656,7 +738,10 @@ class InferenceEngine:
         positions[:, 0] = plen
         if buffers is None:
             buffers = self._new_pool_cache()
-        with self._mesh_ctx():
+        # An intentional pre-compile by definition — the sentinel must not
+        # read the background warm sweep as a serve-time stall (a COLD
+        # admission or runtime prefix_build compile still flags).
+        with obs_device.SENTINEL.expected(), self._mesh_ctx():
             _, buffers, _ = self._prefill_prefix(
                 self.params, buffers, pk, pv,
                 jnp.asarray(toks), jnp.asarray(positions),
@@ -705,6 +790,32 @@ class InferenceEngine:
 
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any())
+
+    # -- device observability hooks ------------------------------------
+
+    def kv_occupancy(self) -> dict:
+        """Token-level KV slot-pool occupancy: the dense [max_slots,
+        max_seq_len] reservation vs the tokens actually cached — the
+        fragmentation number the ROADMAP's paged-KV design exists to fix
+        (docs/observability.md)."""
+        capacity = self.max_slots * self.max_seq_len
+        tokens = int(self.lengths[self.active].sum()) if capacity else 0
+        return {"slots_total": self.max_slots,
+                "slots_active": int(self.active.sum()),
+                "kv_tokens": tokens,
+                "kv_capacity_tokens": capacity,
+                "occupancy_ratio": (tokens / capacity) if capacity else 0.0}
+
+    def memory_groups(self) -> dict:
+        """Named array groups for the live-array attribution census
+        (obs/device.live_array_census): weights, the slot-pool KV cache,
+        and the shared-prefix KV cache. The prefix dict is copied first
+        (one C-level op): the caller is usually an HTTP handler thread
+        while the worker thread registers/evicts prefixes, and iterating
+        the live dict mid-mutation raises."""
+        return {"weights": self.params,
+                "kv_cache": self.cache,
+                "prefix_cache": list(self._prefix_cache.copy().values())}
 
     def _free_slots(self, exclude=()) -> List[int]:
         return [i for i in range(self.max_slots)
@@ -775,6 +886,11 @@ class InferenceEngine:
         splices the cached prefix K/V into every scratch row first."""
         n = len(group)
         plen = len(pkey) if pkey else 0
+        # Prefix hit rate at admission granularity (the auto_prefix
+        # effectiveness number the paged-KV work baselines against).
+        self.prefix_lookups += n
+        if pkey:
+            self.prefix_hits += n
         rows = 1 if n == 1 else self.max_slots
         tokens = np.zeros((rows, bucket), np.int32)
         # Real tokens at positions plen..len-1; padding scatters to the
@@ -827,11 +943,17 @@ class InferenceEngine:
                 first, self.cache, self.rng = self._prefill(
                     self.params, self.cache, *args)
             first = np.asarray(first)
+        # Labeled by (bucket, rows): the two row shapes are different
+        # compiled programs with ~rows-proportional FLOPs, and the
+        # roofline join (/debug/programs) divides per-program FLOPs by
+        # this distribution's mean — blending row shapes would inflate
+        # the burst program's analytic MFU by ~max_slots.
         obs_metrics.REGISTRY.observe(
             "serve_prefill_dispatch_seconds",
             time.perf_counter() - t_dispatch, bucket=str(bucket),
+            rows=str(rows),
             help_text="Prefill dispatch+sync wall time per admission "
-                      "group, labeled by prompt bucket.")
+                      "group, labeled by prompt bucket and row count.")
         for i, (slot, req) in enumerate(group):
             tok = int(first[i])
             self.active[slot] = True

@@ -126,7 +126,11 @@ def create_lora_train_state(model_cfg, lora_cfg: LoraConfig, base_params,
     """Sharded TrainState whose params are the LoRA tree only. Returns
     (state, state_shardings)."""
     import jax.numpy as jnp
-    from runbooks_tpu.train.step import TrainState, infer_state_shardings
+    from runbooks_tpu.train.step import (
+        TrainState,
+        infer_state_shardings,
+        layout_invariant_init,
+    )
 
     def init_fn(rng):
         lora = init_lora(base_params, lora_cfg, rng)
@@ -136,7 +140,7 @@ def create_lora_train_state(model_cfg, lora_cfg: LoraConfig, base_params,
     state_shapes = jax.eval_shape(init_fn, rng)
     axes = lora_logical_axes(lora_cfg, state_shapes.params)
     shardings = infer_state_shardings(axes, state_shapes, mesh, rules)
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(mesh), layout_invariant_init():
         state = jax.jit(init_fn, out_shardings=shardings)(rng)
     return state, shardings
 

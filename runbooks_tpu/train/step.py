@@ -9,6 +9,7 @@ this is the TPU-native equivalent, XLA collectives over ICI/DCN).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
@@ -159,6 +160,55 @@ def batch_shardings(mesh: Mesh, batch_shapes, rules=None) -> Any:
     return jax.tree.map(one, batch_shapes)
 
 
+@contextlib.contextmanager
+def layout_invariant_init():
+    """Make sharded jitted init independent of the device layout.
+
+    The non-partitionable threefry lowering (this jax version's default)
+    generates different random bits when ``jax.random.normal`` runs under
+    ``jit(..., out_shardings=...)`` on different mesh layouts — the
+    carried ROADMAP bug where d2f2t2/d4t2 initial params diverged from
+    dp8/fsdp8 by enough for a 0.75% step-1 loss delta
+    (tests/test_train_step.py::test_mesh_layouts_agree_numerically).
+    The partitionable threefry lowering computes each element's bits from
+    its *global* index, so every layout materializes the same values
+    while still initializing shard-local (no single-host OOM on large
+    models). Scoped to the init call: the flag is part of jit's trace
+    key, so the train step itself is untouched.
+
+    The scope also marks its compiles as *expected* for the compile
+    sentinel (obs/device.py): a sharded init is by definition an
+    intentional startup compile, and must not page an operator when it
+    runs in a process where another component (a colocated serve
+    engine) already declared itself steady.
+
+    The flag flip is THREAD-LOCAL (jax config State context manager)
+    whenever this jax exposes it: a colocated engine decoding on its
+    worker thread must not see its jit cache key change mid-request (a
+    recompile = serve-time stall). The process-global update is only
+    the fallback for jax builds without the context-manager API.
+    """
+    from runbooks_tpu.obs import device as obs_device
+
+    try:
+        from jax._src.config import threefry_partitionable as _tp_state
+
+        ctx = _tp_state(True)
+    except (ImportError, AttributeError, TypeError):
+        ctx = None
+    with obs_device.SENTINEL.expected():
+        if ctx is not None:
+            with ctx:
+                yield
+        else:
+            prev = jax.config.jax_threefry_partitionable
+            jax.config.update("jax_threefry_partitionable", True)
+            try:
+                yield
+            finally:
+                jax.config.update("jax_threefry_partitionable", prev)
+
+
 def create_train_state(
     cfg: ModelConfig,
     optimizer: optax.GradientTransformation,
@@ -170,7 +220,8 @@ def create_train_state(
 
     Returns (state, state_shardings). Init happens inside jit with
     out_shardings so large models materialize already sharded (no single-host
-    OOM).
+    OOM); the partitionable-threefry scope makes the values identical on
+    every mesh layout (see layout_invariant_init).
     """
 
     def init_fn(rng):
@@ -184,7 +235,7 @@ def create_train_state(
     state_shapes = jax.eval_shape(init_fn, rng)
     shardings = infer_state_shardings(param_logical_axes(cfg), state_shapes,
                                       mesh, rules)
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(mesh), layout_invariant_init():
         state = jax.jit(init_fn, out_shardings=shardings)(rng)
     return state, shardings
 

@@ -13,6 +13,7 @@ Entry point: ``python -m runbooks_tpu.train.trainer`` (reads params.json), or
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -25,6 +26,7 @@ import jax
 import numpy as np
 
 from runbooks_tpu.models.config import ModelConfig, get_config
+from runbooks_tpu.obs import device as obs_device
 from runbooks_tpu.obs import trace as obs_trace
 from runbooks_tpu.obs.goodput import GoodputTracker
 from runbooks_tpu.obs.metrics import REGISTRY
@@ -267,7 +269,9 @@ def run_training(job: TrainJobConfig,
                 lambda r: init_params(model_cfg, r), rng)
             base_shardings = tree_shardings(
                 shapes, param_logical_axes(model_cfg), mesh)
-            with jax.set_mesh(mesh):
+            from runbooks_tpu.train.step import layout_invariant_init
+
+            with jax.set_mesh(mesh), layout_invariant_init():
                 base_params = jax.jit(
                     lambda r: init_params(model_cfg, r),
                     out_shardings=base_shardings)(rng)
@@ -289,6 +293,14 @@ def run_training(job: TrainJobConfig,
         step_fn = make_train_step(model_cfg, optimizer, mesh, shardings,
                                   accumulate_steps=job.accumulate_steps,
                                   loss_chunk=job.loss_chunk)
+
+    # Device-level observability (obs/device.py): compile sentinel +
+    # program census. After the first step folds the XLA compile, any
+    # further compile in the steady loop is a stall the sentinel flags
+    # (xla_unexpected_compiles_total) — exactly the failure mode the
+    # at-scale postmortems lead with (PAPERS.md).
+    obs_device.SENTINEL.install()
+    obs_device.PROGRAMS.register("train", "train_step", step_fn)
 
     # May raise on a malformed value — before any state needing cleanup.
     fault = _parse_fault_inject()
@@ -320,6 +332,10 @@ def run_training(job: TrainJobConfig,
     nonfinite_steps = 0
     pending_nf = None      # previous step's (index, nonfinite flag)
     last_saved = -1
+    device_cost = None     # roofline attribution of the train step
+    compiles_before = obs_device.SENTINEL.total
+    unexpected_before = obs_device.SENTINEL.unexpected
+    hbm_peak_bytes = 0
 
     # Goodput accounting (obs/goodput.py): productive step time ÷ wall
     # clock, with restart overhead (restore + compile) excluded so a
@@ -346,6 +362,17 @@ def run_training(job: TrainJobConfig,
             "batches_consumed": consumed,
             "goodput": goodput.ratio() if goodput.steps else None,
             "goodput_detail": goodput.snapshot(),
+            "device_obs": {
+                # Analytic cross-check of the wall-clock MFU: FLOPs and
+                # HBM bytes from the compiled step's cost_analysis, with
+                # the roofline classification (docs/observability.md).
+                "cost": device_cost,
+                "formula_flops_per_step": flops_per_token * tokens_per_step,
+                "compiles": obs_device.SENTINEL.total - compiles_before,
+                "unexpected_compiles":
+                    obs_device.SENTINEL.unexpected - unexpected_before,
+                "hbm_peak_bytes": hbm_peak_bytes or None,
+            },
             "history": history,
         }
         if in_progress:
@@ -489,7 +516,15 @@ def run_training(job: TrainJobConfig,
                     batch = dict(batch)
                     batch["loss_mask"] = batch["loss_mask"] * float("nan")
                 t_step = time.perf_counter()
-                with span("step", step=i):
+                # The first step folds this run's intended XLA compile:
+                # with a colocated component already steady (a serve
+                # engine sharing the process), it must not read as a
+                # stall. Later steps run unwrapped — a compile THERE is
+                # exactly what the sentinel exists to catch.
+                expected_cm = (obs_device.SENTINEL.expected()
+                               if i == start_step
+                               else contextlib.nullcontext())
+                with span("step", step=i), expected_cm:
                     if lora_mode:
                         state, metrics = step_fn(state, base_params, batch)
                     else:
@@ -506,6 +541,31 @@ def run_training(job: TrainJobConfig,
                     float(metrics["loss"])
                     compile_time_s = time.perf_counter() - t_start
                     goodput.exclude(compile_time_s, "compile")
+                    # Compile phase over: from here a compile in the step
+                    # loop is a stall the sentinel flags loudly.
+                    obs_device.SENTINEL.mark_steady("train")
+                    if os.environ.get("RBT_DEVICE_OBS", "1") != "0":
+                        # Roofline attribution of the step program: FLOPs
+                        # + HBM bytes from the lowering's cost_analysis
+                        # (a re-trace, no second backend compile) — the
+                        # analytic cross-check for the wall-clock MFU.
+                        # The re-trace is startup overhead like the
+                        # compile itself: excluded from goodput's window.
+                        t_cost = time.perf_counter()
+                        args = ((state, base_params, batch) if lora_mode
+                                else (state, batch))
+                        device_cost = obs_device.cost_analysis_of(
+                            step_fn, *args)
+                        if device_cost is not None:
+                            device_cost.update(obs_device.classify_roofline(
+                                device_cost["flops"],
+                                device_cost["hbm_bytes"]))
+                            obs_device.PROGRAMS.record_cost(
+                                "train", "train_step",
+                                f"b{job.batch_size}s{job.seq_len}",
+                                device_cost)
+                        goodput.exclude(
+                            time.perf_counter() - t_cost, "compile")
                     t_start = time.perf_counter()
                 else:
                     tokens_done += tokens_per_step
@@ -533,7 +593,10 @@ def run_training(job: TrainJobConfig,
                 ckpt_s = 0.0
                 if (i + 1) % job.checkpoint_every == 0 or i + 1 == job.steps:
                     t_ckpt = time.perf_counter()
-                    with span("checkpoint", step=i + 1):
+                    # expected(): checkpoint plumbing may compile small
+                    # host programs; that is not a step-loop stall.
+                    with span("checkpoint", step=i + 1), \
+                            obs_device.SENTINEL.expected():
                         ckpt.save(i + 1, state,
                                   cursor={"batches_consumed": consumed})
                     ckpt_s = time.perf_counter() - t_ckpt
@@ -597,6 +660,28 @@ def run_training(job: TrainJobConfig,
                     REGISTRY.set_gauge(
                         "train_loss", round(loss, 6),
                         help_text="Loss at the last logged step.")
+                    # Per-step HBM watermark (device_memory_* gauges;
+                    # absent on CPU where memory_stats() is None) and the
+                    # analytic-MFU cross-check from the step program's
+                    # cost_analysis.
+                    hbm_now = max(
+                        (m.get("bytes_in_use", 0)
+                         for m in obs_device.set_memory_gauges()),
+                        default=0)
+                    if hbm_now:
+                        entry["hbm_used_bytes"] = hbm_now
+                        hbm_peak_bytes = max(hbm_peak_bytes, hbm_now)
+                    if device_cost and win["steps"] and peak_flops:
+                        entry["analytic_mfu"] = round(
+                            device_cost["flops"]
+                            / (win["step"] / win["steps"]) / peak_flops, 4)
+                        REGISTRY.set_gauge(
+                            "train_analytic_mfu", entry["analytic_mfu"],
+                            help_text="cost_analysis FLOPs / measured "
+                                      "step time / peak — the analytic "
+                                      "cross-check of the wall-clock "
+                                      "MFU.")
+                    obs_device.PROGRAMS.set_gauges(component="train")
                     win = {"data": 0.0, "step": 0.0, "ckpt": 0.0,
                            "steps": 0}
                     history.append(entry)
@@ -612,7 +697,8 @@ def run_training(job: TrainJobConfig,
                 step_now = int(state.step)
                 if step_now != last_saved:
                     with span("emergency_save", step=step_now,
-                              reason=exit_reason):
+                              reason=exit_reason), \
+                            obs_device.SENTINEL.expected():
                         ckpt.save(step_now, state,
                                   cursor={"batches_consumed": consumed},
                                   force=True)
@@ -626,6 +712,9 @@ def run_training(job: TrainJobConfig,
             prefetcher.close()
         if poller_stop is not None:
             poller_stop.set()
+        # This run's steady claim dies with it: a follow-up run (resume,
+        # tests, a second job in-process) recompiles legitimately.
+        obs_device.SENTINEL.clear_steady("train")
         # Async-checkpoint cleanup belongs HERE: an exception mid-run must
         # not leave the orbax save thread dangling with a half-written step
         # directory (wait() also stamps the integrity markers; close()

@@ -21,3 +21,26 @@ def chip_peak_flops(device) -> float:
         if key in kind:
             return val
     return 0.0
+
+
+# HBM bandwidth (bytes/s) per chip by TPU generation — the memory roofline
+# (obs/device.py classifies programs against peak_flops / bandwidth).
+HBM_BW = {
+    "v5 lite": 819e9,    # v5e
+    "v5litepod": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v6e": 1640e9,
+}
+
+
+def chip_hbm_bandwidth(device) -> float:
+    """HBM bandwidth (bytes/s) for a jax.Device; 0.0 when unknown, so
+    callers substitute an explicit nominal instead of dividing by a
+    silent guess."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in HBM_BW.items():
+        if key in kind:
+            return val
+    return 0.0

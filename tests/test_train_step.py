@@ -68,8 +68,10 @@ def test_train_step_runs_and_learns(mesh_config):
     assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
 
 
-@pytest.mark.slow
 def test_mesh_layouts_agree_numerically():
+    # Green since the layout-invariant init fix (partitionable-threefry
+    # scope in create_train_state — the sharding must not change the
+    # values the init materializes, whatever the mesh layout).
     ref_losses, _ = run_steps(MeshConfig(data=8, fsdp=1, sequence=1, tensor=1))
     for mc in [MeshConfig(data=1, fsdp=8, sequence=1, tensor=1),
                MeshConfig(data=2, fsdp=2, sequence=1, tensor=2)]:
