@@ -28,6 +28,15 @@ test-fast: test
 bench:
 	python bench.py
 
+# Static program & concurrency audit (docs/static-analysis.md): AST lint
+# for the recurring concurrency/precision defect classes + abstract
+# jaxpr contracts over the registered hot programs. Strict = also fail
+# on stale baseline suppressions, any XLA backend compile during the
+# audit (it must be pure abstract tracing), and a >30 s wall time.
+.PHONY: check
+check:
+	$(TEST_ENV) python -m runbooks_tpu.cli.main check --strict --budget-s 30
+
 # Regenerate CRD manifests (reference analog: `make manifests`).
 .PHONY: manifests
 manifests:

@@ -49,6 +49,14 @@ EOF
 
 printf '\n## Sweep %s\n\n| run | result |\n|---|---|\n' "${stamp}" >> "${summary}"
 
+# 0. Static program & concurrency audit (docs/static-analysis.md): the
+#    `make check` CI gate staged first so every capture proves the repo
+#    audits clean — zero XLA backend compiles (pure abstract tracing,
+#    sentinel-verified) inside a 30 s CPU wall budget. Value = audit
+#    wall seconds (vs_baseline = budget/actual, > 1).
+run static-check env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/check_gate.py 30
+
 # 1. Headline train+serve (the exact line the driver records).
 run baseline python bench.py
 

@@ -1,0 +1,35 @@
+"""Static program & concurrency auditor behind `rbt check` / `make check`.
+
+Two sides, one findings model (docs/static-analysis.md):
+
+- :mod:`runbooks_tpu.analysis.program` — **program contracts**: the
+  registered steady-state programs (engine prefill/decode per
+  bucket/view, train step, LoRA step) are traced ABSTRACTLY
+  (``jax.make_jaxpr`` over ``ShapeDtypeStruct`` trees — zero device
+  arrays, zero XLA backend compiles) and audited for host callbacks,
+  silent low-precision→f32 promotions, closure-captured constants, and
+  compiled-program-census drift against ``config/program_baseline.json``.
+- :mod:`runbooks_tpu.analysis.lint` — **repo-invariant lint**: AST-based
+  checks for lock discipline (``# guarded-by:`` annotations), blocking
+  calls in ``async def``, device syncs on the serve/train hot paths,
+  jitted RNG init without the layout-invariant threefry scope, and
+  bare/swallowed exception handlers.
+
+Both report through :mod:`runbooks_tpu.analysis.findings`, with
+per-finding suppression via ``config/check_baseline.json`` and inline
+``# rbt-check: ignore[rule]`` comments, so the repo ships clean and new
+violations fail CI (`make check`).
+"""
+
+from runbooks_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    Suppression,
+    apply_baseline,
+    load_baseline,
+)
+
+
+def run_check(*args, **kwargs):  # noqa: D103 — thin lazy re-export
+    from runbooks_tpu.analysis.check import run_check as _run
+
+    return _run(*args, **kwargs)

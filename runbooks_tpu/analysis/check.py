@@ -1,0 +1,116 @@
+"""`rbt check` runner: lint + program contracts + baselines, one report.
+
+The contract (docs/static-analysis.md, Makefile `make check`):
+
+- the repo at HEAD is CLEAN — `rbt check --strict` exits 0 with the
+  committed baselines;
+- every new violation fails CI (active findings -> nonzero);
+- --strict additionally fails on STALE baseline suppressions (a fixed
+  violation must take its suppression with it) and on any XLA backend
+  compile during the program audit (the audit is abstract tracing only;
+  a compile means someone snuck real execution into it — verified with
+  the PR-7 compile sentinel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import List, Optional
+
+from runbooks_tpu.analysis.findings import (
+    Finding,
+    Suppression,
+    apply_baseline,
+    load_baseline,
+)
+
+CHECK_BASELINE = os.path.join("config", "check_baseline.json")
+PROGRAM_BASELINE = os.path.join("config", "program_baseline.json")
+
+
+def find_repo_root(start: Optional[str] = None) -> str:
+    """Nearest ancestor holding pyproject.toml (the repo root), so
+    `rbt check` works from any cwd inside the checkout."""
+    cur = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start or os.getcwd())
+        cur = parent
+
+
+@dataclasses.dataclass
+class CheckReport:
+    active: List[Finding]
+    suppressed: List[Finding]
+    stale: List[Suppression]
+    census: Optional[dict]
+    compiles: int
+    seconds: float
+    # False when jax.monitoring is unavailable: `compiles == 0` is then
+    # VACUOUS, not verified (the PR-7 bench gate learned this the hard
+    # way). CI (tools/check_gate.py) fails on it; interactive runs warn.
+    monitoring: bool = True
+
+    def exit_code(self, strict: bool = False) -> int:
+        if self.active:
+            return 1
+        if strict and self.stale:
+            return 2
+        if strict and self.compiles:
+            return 4
+        return 0
+
+
+def run_check(root: Optional[str] = None, *, programs: bool = True,
+              lint: bool = True,
+              write_baseline: bool = False) -> CheckReport:
+    """Run both audit sides against the repo at `root`.
+
+    write_baseline=True regenerates config/program_baseline.json from
+    the current census instead of diffing against it (use after an
+    intentional program-set change, then commit the file)."""
+    root = root or find_repo_root()
+    t0 = time.perf_counter()
+    findings: List[Finding] = []
+    census: Optional[dict] = None
+    compiles = 0
+    monitoring = True
+    if lint:
+        from runbooks_tpu.analysis.lint import lint_paths
+
+        findings.extend(lint_paths(root))
+    if programs:
+        # The audit must never execute device code: the sentinel counts
+        # backend compiles across it, and --strict fails on any.
+        from runbooks_tpu.obs import device as obs_device
+
+        from runbooks_tpu.analysis.program import (
+            audit_programs,
+            diff_census,
+            load_program_baseline,
+            write_program_baseline,
+        )
+
+        monitoring = obs_device.SENTINEL.install()
+        before = obs_device.SENTINEL.total
+        census, prog_findings = audit_programs()
+        findings.extend(prog_findings)
+        compiles = obs_device.SENTINEL.total - before
+        baseline_path = os.path.join(root, PROGRAM_BASELINE)
+        if write_baseline:
+            write_program_baseline(baseline_path, census)
+        else:
+            findings.extend(diff_census(
+                census, load_program_baseline(baseline_path),
+                os.path.relpath(baseline_path, root)))
+    baseline = load_baseline(os.path.join(root, CHECK_BASELINE))
+    active, suppressed, stale = apply_baseline(findings, baseline)
+    return CheckReport(active=active, suppressed=suppressed, stale=stale,
+                       census=census, compiles=compiles,
+                       seconds=time.perf_counter() - t0,
+                       monitoring=monitoring)

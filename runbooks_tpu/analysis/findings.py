@@ -1,0 +1,97 @@
+"""Shared findings model for `rbt check` (docs/static-analysis.md).
+
+A finding is one rule violation at one location. Program-contract
+findings use a ``program:<component>/<name>`` pseudo-path (there is no
+file:line for a jaxpr); lint findings carry repo-relative paths and
+1-based lines.
+
+Suppression is two-tier, both requiring a reason:
+
+- inline: a ``# rbt-check: ignore[<rule>] <reason>`` comment on the
+  flagged line (handled inside lint.py, where the source is at hand);
+- baseline: an entry in ``config/check_baseline.json`` —
+  ``{"rule": ..., "path": ..., "contains": ..., "reason": ...}`` —
+  matched here. ``contains`` (optional) must be a substring of the
+  finding message, so one entry cannot blanket a whole rule.
+
+`rbt check --strict` additionally fails on STALE baseline entries
+(suppressions that matched nothing): a fixed violation must take its
+suppression with it, or the baseline rots into a blanket allowlist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str       # e.g. "lock-discipline", "program-callback"
+    path: str       # repo-relative file, or "program:<component>/<name>"
+    line: int       # 1-based; 0 for program-level findings
+    message: str
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    rule: str
+    path: str
+    reason: str
+    contains: Optional[str] = None
+
+    def matches(self, f: Finding) -> bool:
+        return (self.rule == f.rule and self.path == f.path
+                and (self.contains is None or self.contains in f.message))
+
+
+def load_baseline(path: str) -> List[Suppression]:
+    """Parse config/check_baseline.json. A malformed baseline raises:
+    an unreadable suppression list silently suppressing nothing (or
+    everything) is worse than a loud failure in CI."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    out: List[Suppression] = []
+    for i, entry in enumerate(data.get("suppressions", [])):
+        missing = {"rule", "path", "reason"} - set(entry)
+        if missing:
+            raise ValueError(
+                f"{path}: suppression #{i} missing {sorted(missing)} "
+                "(every entry needs rule, path, and a reason)")
+        out.append(Suppression(rule=entry["rule"], path=entry["path"],
+                               reason=entry["reason"],
+                               contains=entry.get("contains")))
+    return out
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Sequence[Suppression],
+) -> Tuple[List[Finding], List[Finding], List[Suppression]]:
+    """(active, suppressed, stale_suppressions)."""
+    used: Dict[int, bool] = {i: False for i in range(len(baseline))}
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        hit = None
+        for i, s in enumerate(baseline):
+            if s.matches(f):
+                hit = i
+                break
+        if hit is None:
+            active.append(f)
+        else:
+            used[hit] = True
+            suppressed.append(f)
+    stale = [baseline[i] for i, u in used.items() if not u]
+    return active, suppressed, stale

@@ -1,0 +1,457 @@
+"""Repo-invariant lint: AST checks for the defect classes that keep
+recurring in review (docs/static-analysis.md has the rule catalog).
+
+Every rule here encodes a bug class a past PR actually shipped or caught
+in review:
+
+- ``lock-discipline``: attributes annotated ``# guarded-by: <lock>`` at
+  their ``__init__`` assignment must only be touched inside a
+  ``with self.<lock>:`` block (the EngineWorker/fleet-scraper bug class
+  review fixed twice in PR 6/7). A ``# guarded-by: <lock>`` comment on a
+  ``def`` line instead marks a *lock-held helper* — a private method the
+  class only calls with the lock already held — and the whole body is
+  treated as guarded.
+- ``async-blocking``: blocking calls (``time.sleep``, subprocess, sync
+  urllib/socket, ``Future.result()``) inside ``async def`` freeze the
+  whole event loop — every SSE stream and readiness probe with it.
+- ``device-sync``: host↔device syncs (``np.asarray`` on device values,
+  ``.item()``, ``block_until_ready``, ``jax.device_get``) on the serve/
+  train hot paths (``serve/engine.py``, ``train/step.py``). Intentional
+  dispatch boundaries carry an inline ignore naming why.
+- ``rng-layout``: ``jax.jit(..., out_shardings=...)`` over RNG init
+  (``jax.random.*`` / ``init_params`` / ``init_lora``) outside a
+  ``layout_invariant_init()`` scope — the exact carried-bug class of
+  the non-partitionable threefry lowering (train/step.py).
+- ``bare-except``: ``except:`` catches SystemExit/KeyboardInterrupt and
+  hides typos; name a type.
+- ``swallowed-error``: a broad ``except Exception``/``BaseException``
+  whose body is only ``pass``/``continue`` with no comment explaining
+  why silence is correct.
+
+Suppression is inline — ``# rbt-check: ignore[<rule>] <reason>`` on the
+flagged line (or alone on the line above) — or via
+``config/check_baseline.json`` (findings.py). Inline ignores without a
+reason are themselves flagged (``ignore-reason``): an unexplained
+suppression is how baselines rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from runbooks_tpu.analysis.findings import Finding
+
+# Files the device-sync rule audits: the serve decode loop and the train
+# step — the two places where an accidental host sync is a per-token /
+# per-step stall on TPU.
+DEVICE_SYNC_PATHS = ("serve/engine.py", "train/step.py")
+
+# (module, attr) call patterns that block the event loop.
+_BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"),
+    ("os", "system"),
+    ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("socket", "create_connection"),
+    ("requests", "get"), ("requests", "post"), ("requests", "put"),
+    ("requests", "delete"), ("requests", "request"),
+}
+
+_IGNORE_RE = re.compile(
+    r"#\s*rbt-check:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(.*)")
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*(?:self\.)?([A-Za-z0-9_]+)")
+
+
+class _Ignores:
+    """Per-file inline suppressions: line -> set of rule ids ('*' = all).
+    A comment alone on a line applies to the next line too (for lines too
+    long to carry the comment inline)."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.missing_reason: List[Tuple[int, str]] = []
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _IGNORE_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if not m.group(2).strip():
+                self.missing_reason.append((i, ",".join(sorted(rules))))
+            self.by_line.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):
+                # Comment-only line: the suppression targets the next line.
+                self.by_line.setdefault(i + 1, set()).update(rules)
+
+    def active(self, line: int, rule: str) -> bool:
+        rules = self.by_line.get(line, ())
+        return rule in rules or "*" in rules
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_self_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+def _guarded_attrs(cls: ast.ClassDef, lines: List[str]) -> Dict[str, str]:
+    """attr -> lock name, from `self.X = ...  # guarded-by: <lock>` lines
+    anywhere in the class body (conventionally __init__)."""
+    guards: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if not _is_self_attr(t):
+                continue
+            m = _GUARDED_BY_RE.search(lines[node.lineno - 1])
+            if m:
+                guards[t.attr] = m.group(1)
+    return guards
+
+
+class _LockVisitor(ast.NodeVisitor):
+    """Flags guarded self-attribute accesses outside `with self.<lock>:`."""
+
+    def __init__(self, guards: Dict[str, str], rel: str, ignores: _Ignores,
+                 findings: List[Finding]):
+        self.guards = guards
+        self.rel = rel
+        self.ignores = ignores
+        self.findings = findings
+        self.held: List[str] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            chain = _attr_chain(expr)
+            if chain and chain.startswith("self."):
+                acquired.append(chain[len("self."):])
+        self.held.extend(acquired)
+        self.generic_visit(node)
+        del self.held[len(self.held) - len(acquired):]
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if _is_self_attr(node) and node.attr in self.guards:
+            lock = self.guards[node.attr]
+            if lock not in self.held \
+                    and not self.ignores.active(node.lineno,
+                                               "lock-discipline"):
+                self.findings.append(Finding(
+                    rule="lock-discipline", path=self.rel,
+                    line=node.lineno,
+                    message=f"self.{node.attr} is `# guarded-by: {lock}` "
+                            f"but accessed outside `with self.{lock}:`"))
+        self.generic_visit(node)
+
+
+def _check_locks(tree: ast.Module, rel: str, lines: List[str],
+                 ignores: _Ignores, findings: List[Finding]) -> None:
+    for cls in (n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)):
+        guards = _guarded_attrs(cls, lines)
+        if not guards:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":
+                continue  # construction happens-before any other thread
+            v = _LockVisitor(guards, rel, ignores, findings)
+            m = _GUARDED_BY_RE.search(lines[fn.lineno - 1])
+            if m:
+                # Lock-held helper: the def line's annotation asserts the
+                # class only calls this with <lock> already held.
+                v.held.append(m.group(1))
+            v.visit(fn)
+
+
+# ---------------------------------------------------------------------------
+# async-blocking
+# ---------------------------------------------------------------------------
+
+class _AsyncVisitor(ast.NodeVisitor):
+    def __init__(self, rel: str, ignores: _Ignores,
+                 findings: List[Finding]):
+        self.rel = rel
+        self.ignores = ignores
+        self.findings = findings
+
+    # Nested sync defs/lambdas inside an async def typically run in an
+    # executor or a worker thread — only the coroutine body itself is
+    # audited.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    # A nested async def gets its OWN visitor from _check_async's walk;
+    # descending here too would report its findings twice.
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        if not self.ignores.active(node.lineno, "async-blocking"):
+            self.findings.append(Finding(
+                rule="async-blocking", path=self.rel, line=node.lineno,
+                message=f"{what} inside `async def` blocks the event loop "
+                        "(every stream and probe with it); await an async "
+                        "equivalent or run_in_executor"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func)
+        if chain:
+            parts = tuple(chain.split("."))
+            tail2 = parts[-2:] if len(parts) >= 2 else ()
+            if tail2 in _BLOCKING_MODULE_CALLS \
+                    or parts[:2] == ("urllib", "request"):
+                self._flag(node, f"blocking call {chain}()")
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "result" and not node.args:
+            # Future.result() blocks; asyncio code awaits wrap_future.
+            self._flag(node, ".result()")
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" and not node.args:
+            # A no-positional-arg .join() is a thread join (str.join
+            # always takes the iterable); it parks the event loop for
+            # the full timeout. Join in an executor.
+            self._flag(node, ".join()")
+        self.generic_visit(node)
+
+
+def _check_async(tree: ast.Module, rel: str, ignores: _Ignores,
+                 findings: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            v = _AsyncVisitor(rel, ignores, findings)
+            for stmt in node.body:
+                v.visit(stmt)
+
+
+# ---------------------------------------------------------------------------
+# device-sync
+# ---------------------------------------------------------------------------
+
+def _check_device_sync(tree: ast.Module, rel: str, ignores: _Ignores,
+                       findings: List[Finding]) -> None:
+    if not rel.replace(os.sep, "/").endswith(DEVICE_SYNC_PATHS):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func) or ""
+        what = None
+        if chain in ("np.asarray", "numpy.asarray", "jax.device_get"):
+            what = f"{chain}()"
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "block_until_ready") \
+                and not node.args:
+            what = f".{node.func.attr}()"
+        elif chain == "jax.block_until_ready":
+            what = "jax.block_until_ready()"
+        if what and not ignores.active(node.lineno, "device-sync"):
+            findings.append(Finding(
+                rule="device-sync", path=rel, line=node.lineno,
+                message=f"{what} on the hot path forces a host↔device "
+                        "sync per call; keep syncs at the allowlisted "
+                        "dispatch boundaries (inline-ignore with a reason "
+                        "if this IS one)"))
+
+
+# ---------------------------------------------------------------------------
+# rng-layout
+# ---------------------------------------------------------------------------
+
+_RNG_CALLEES = {"init_params", "init_lora"}
+
+
+def _calls_rng(fn_node: ast.AST) -> bool:
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func) or ""
+        if ".random." in f".{chain}." and chain.startswith(("jax.",
+                                                           "random.")):
+            return True
+        tail = chain.rsplit(".", 1)[-1]
+        if tail in _RNG_CALLEES:
+            return True
+    return False
+
+
+class _RngVisitor(ast.NodeVisitor):
+    """Flags jax.jit(..., out_shardings=...) over RNG-initializing bodies
+    outside a `with layout_invariant_init():` scope."""
+
+    def __init__(self, rel: str, ignores: _Ignores,
+                 findings: List[Finding]):
+        self.rel = rel
+        self.ignores = ignores
+        self.findings = findings
+        self.scoped_depth = 0
+        self.local_defs: List[Dict[str, ast.AST]] = [{}]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.local_defs[-1][node.name] = node
+        self.local_defs.append({})
+        self.generic_visit(node)
+        self.local_defs.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_With(self, node: ast.With) -> None:
+        scoped = any(
+            (_attr_chain(i.context_expr.func
+                         if isinstance(i.context_expr, ast.Call)
+                         else i.context_expr) or ""
+             ).endswith("layout_invariant_init")
+            for i in node.items)
+        self.scoped_depth += int(scoped)
+        self.generic_visit(node)
+        self.scoped_depth -= int(scoped)
+
+    def _target_ast(self, arg: ast.AST) -> Optional[ast.AST]:
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            for scope in reversed(self.local_defs):
+                if arg.id in scope:
+                    return scope[arg.id]
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func) or ""
+        is_jit = chain.endswith(".jit") or chain == "jit"
+        has_out = any(k.arg == "out_shardings" for k in node.keywords)
+        if is_jit and has_out and node.args and not self.scoped_depth:
+            target = self._target_ast(node.args[0])
+            if target is not None and _calls_rng(target) \
+                    and not self.ignores.active(node.lineno, "rng-layout"):
+                self.findings.append(Finding(
+                    rule="rng-layout", path=self.rel, line=node.lineno,
+                    message="jitted RNG init with out_shardings outside "
+                            "layout_invariant_init(): the non-partitionable "
+                            "threefry lowering makes the values depend on "
+                            "the mesh layout (train/step.py)"))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# bare-except / swallowed-error
+# ---------------------------------------------------------------------------
+
+def _broad_except(node: ast.ExceptHandler) -> bool:
+    names = []
+    t = node.type
+    for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+        n = _attr_chain(el) if el is not None else None
+        if n:
+            names.append(n.rsplit(".", 1)[-1])
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _check_excepts(tree: ast.Module, rel: str, lines: List[str],
+                   ignores: _Ignores, findings: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            if not ignores.active(node.lineno, "bare-except"):
+                findings.append(Finding(
+                    rule="bare-except", path=rel, line=node.lineno,
+                    message="bare `except:` also catches SystemExit/"
+                            "KeyboardInterrupt and hides typos; name an "
+                            "exception type"))
+            continue
+        if not _broad_except(node):
+            continue
+        body_is_silent = (
+            len(node.body) == 1
+            and isinstance(node.body[0], (ast.Pass, ast.Continue)))
+        # A justification comment anywhere in the handler (the except
+        # line or the body) counts — `pass  # knob absent on older jax`
+        # is as deliberate as a comment up on the except line.
+        end = max(node.lineno, getattr(node, "end_lineno", node.lineno)
+                  or node.lineno)
+        has_comment = any("#" in lines[i - 1]
+                          for i in range(node.lineno, end + 1)
+                          if i - 1 < len(lines))
+        if body_is_silent and not has_comment \
+                and not ignores.active(node.lineno, "swallowed-error"):
+            findings.append(Finding(
+                rule="swallowed-error", path=rel, line=node.lineno,
+                message="broad except swallows the error with no comment "
+                        "saying why silence is correct; narrow the type, "
+                        "log it, or justify it on the except line"))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, rel: str) -> List[Finding]:
+    """Lint one file's source. `rel` is the repo-relative path (rules like
+    device-sync scope on it)."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Finding(rule="syntax", path=rel, line=exc.lineno or 0,
+                        message=f"unparseable: {exc.msg}")]
+    lines = source.splitlines() or [""]
+    ignores = _Ignores(source)
+    for line, rules in ignores.missing_reason:
+        findings.append(Finding(
+            rule="ignore-reason", path=rel, line=line,
+            message=f"inline ignore[{rules}] has no reason; say why "
+                    "(unexplained suppressions rot into blanket "
+                    "allowlists)"))
+    _check_locks(tree, rel, lines, ignores, findings)
+    _check_async(tree, rel, ignores, findings)
+    _check_device_sync(tree, rel, ignores, findings)
+    _RngVisitor(rel, ignores, findings).visit(tree)
+    _check_excepts(tree, rel, lines, ignores, findings)
+    return findings
+
+
+def lint_paths(root: str, package: str = "runbooks_tpu") -> List[Finding]:
+    """Lint every .py file under root/<package>, repo-relative paths."""
+    findings: List[Finding] = []
+    base = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fname)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            with open(full, encoding="utf-8") as f:
+                findings.extend(lint_source(f.read(), rel))
+    return findings

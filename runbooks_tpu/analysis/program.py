@@ -1,0 +1,458 @@
+"""Program contracts: abstract audit of the registered hot programs.
+
+The steady-state program set — engine prefill/decode/prefix-build per
+bucket/view (serve/engine.py's module-level ``make_*_fn`` factories),
+the train step, the LoRA step — is traced ABSTRACTLY here:
+``jax.eval_shape`` builds ShapeDtypeStruct trees and ``jax.make_jaxpr``
+stages each program out. Zero device arrays, zero XLA backend compiles
+(`rbt check` asserts this via the PR-7 compile sentinel), so the audit
+runs in CI in seconds while covering exactly the bodies the engine jits
+(the factories are shared — the engine cannot ship a program this audit
+never saw).
+
+Per-program checks on the jaxpr (recursing through pjit/scan/cond/remat
+sub-jaxprs):
+
+- **program-callback**: host callbacks (``pure_callback``,
+  ``io_callback``, ``jax.debug.print``/``debug_callback``) have no place
+  in a steady-state program — each invocation is a device→host round
+  trip per dispatch.
+- **program-dtype**: a silent low-precision→f32 upcast
+  (``convert_element_type``) materializing a tensor above
+  ``f32_upcast_bytes`` — the "stray f32 promotion in a bf16 program"
+  class. Intentional f32 accumulators (dot_general with
+  ``preferred_element_type``, scalar loss/LSE accumulators, norms over
+  small activations) stay under the threshold by construction.
+- **program-const**: closure-captured constants above ``const_bytes``
+  embedded in the jaxpr — they bloat every compile and pin HBM per
+  compiled variant (weights must be *arguments*).
+- **program-census-drift**: the signature cardinality per program
+  (buckets × row counts, decode views, auto-prefix splice set) and the
+  per-program flags must match ``config/program_baseline.json`` —
+  the compiled-program census is a budget, and silent growth is a
+  compile-time regression nobody notices until readiness stalls
+  (arXiv:2011.03641's compilation-discipline lesson). Regenerate with
+  ``rbt check --write-baseline`` when growth is intentional.
+
+Static-shape discipline is asserted structurally: every traced aval must
+have a concrete integer shape (no dynamic dims).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from runbooks_tpu.analysis.findings import Finding
+
+# Dtypes whose silent widening to f32 we audit.
+LOW_PRECISION = {"bfloat16", "float16", "int8", "uint8", "int4", "uint4"}
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditSettings:
+    """Shapes the audit traces at. Small on purpose — the contracts under
+    test (callbacks, promotions, constants, census cardinality) are
+    shape-independent, and small shapes keep intentional f32 accumulators
+    (norm/LSE upcasts) under the byte thresholds so only genuinely large
+    silent promotions flag."""
+    config: str = "debug"
+    max_slots: int = 2
+    decode_chunk: int = 2
+    batch: int = 2
+    seq: int = 64
+    f32_upcast_bytes: int = 1 << 20   # 1 MiB
+    const_bytes: int = 1 << 20        # 1 MiB
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(value: Any):
+    import jax
+
+    if isinstance(value, jax.core.ClosedJaxpr):
+        yield value.jaxpr, list(value.consts)
+    elif isinstance(value, jax.core.Jaxpr):
+        yield value, []
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+
+
+def iter_jaxprs(closed) -> List[Tuple[Any, List[Any]]]:
+    """Every (jaxpr, consts) pair reachable from a ClosedJaxpr, including
+    pjit/scan/while/cond/checkpoint bodies."""
+    out: List[Tuple[Any, List[Any]]] = [(closed.jaxpr,
+                                         list(closed.consts))]
+    stack = [closed.jaxpr]
+    while stack:
+        jaxpr = stack.pop()
+        for eqn in jaxpr.eqns:
+            for sub, consts in [
+                    p for v in eqn.params.values() for p in _sub_jaxprs(v)]:
+                out.append((sub, consts))
+                stack.append(sub)
+    return out
+
+
+def _source_hint(eqn) -> str:
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return (f" (traced at "
+                    f"{os.path.basename(frame.file_name)}:"
+                    f"{frame.start_line})")
+    except Exception:  # noqa: BLE001 — the hint is decorative
+        pass
+    return ""
+
+
+def audit_jaxpr(closed, program: str,
+                settings: AuditSettings) -> Tuple[List[Finding], dict]:
+    """Content checks over one program's closed jaxpr. Returns
+    (findings, flags) with flags = {callbacks, f32_upcasts,
+    const_bytes_max} — the numbers the census baseline pins."""
+    path = f"program:{program}"
+    findings: List[Finding] = []
+    callbacks = 0
+    upcasts = 0
+    const_max = 0
+    for jaxpr, consts in iter_jaxprs(closed):
+        for var, const in zip(jaxpr.constvars, consts):
+            nbytes = getattr(const, "nbytes", None)
+            if nbytes is None:
+                size = getattr(const, "size", 0) or 0
+                item = getattr(getattr(const, "dtype", None),
+                               "itemsize", 1)
+                nbytes = int(size) * int(item)
+            const_max = max(const_max, int(nbytes))
+            if nbytes >= settings.const_bytes:
+                findings.append(Finding(
+                    rule="program-const", path=path, line=0,
+                    message=f"closure-captured constant of {nbytes} bytes "
+                            f"(shape {getattr(const, 'shape', '?')}) "
+                            "embedded in the jaxpr — it bloats every "
+                            "compile and pins HBM per variant; pass it as "
+                            "an argument"))
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if "callback" in name:
+                callbacks += 1
+                findings.append(Finding(
+                    rule="program-callback", path=path, line=0,
+                    message=f"host callback `{name}` in a steady-state "
+                            "program — a device→host round trip per "
+                            f"dispatch{_source_hint(eqn)}"))
+                continue
+            if name != "convert_element_type" or not eqn.invars:
+                continue
+            in_aval = getattr(eqn.invars[0], "aval", None)
+            out_aval = getattr(eqn.outvars[0], "aval", None)
+            if in_aval is None or out_aval is None:
+                continue
+            if str(getattr(in_aval, "dtype", "")) not in LOW_PRECISION:
+                continue
+            if str(getattr(out_aval, "dtype", "")) != "float32":
+                continue
+            nbytes = int(math.prod(out_aval.shape)) * 4
+            if nbytes >= settings.f32_upcast_bytes:
+                upcasts += 1
+                findings.append(Finding(
+                    rule="program-dtype", path=path, line=0,
+                    message=f"silent {in_aval.dtype}→float32 upcast "
+                            f"materializing {nbytes} bytes "
+                            f"(shape {tuple(out_aval.shape)})"
+                            f"{_source_hint(eqn)}; accumulate explicitly "
+                            "(preferred_element_type) or keep the tensor "
+                            "in the low dtype"))
+        for var in list(jaxpr.invars) + list(jaxpr.outvars):
+            shape = getattr(getattr(var, "aval", None), "shape", ())
+            if not all(isinstance(d, int) for d in shape):
+                findings.append(Finding(
+                    rule="program-shape", path=path, line=0,
+                    message=f"non-static dimension in {shape}: the "
+                            "engine's compiled-program census assumes "
+                            "static shapes everywhere"))
+    return findings, {"callbacks": callbacks, "f32_upcasts": upcasts,
+                      "const_bytes_max": const_max}
+
+
+# ---------------------------------------------------------------------------
+# The audited program set
+# ---------------------------------------------------------------------------
+
+def _key_sds():
+    import jax
+
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _engine_specs(settings: AuditSettings) -> List[dict]:
+    """(name, fn, args, signatures) for the serve engine's program set —
+    built from the same module-level factories and bucket helpers the
+    engine itself uses (serve/engine.py)."""
+    import jax.numpy as jnp
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import KVCache, init_params
+    from runbooks_tpu.serve.engine import (
+        _buckets,
+        auto_prefix_plens,
+        bucket_for,
+        make_decode_fn,
+        make_prefill_fn,
+        make_prefix_build_fn,
+        view_buckets_for,
+    )
+    import jax
+
+    cfg = get_config(settings.config)
+    max_seq_len = cfg.max_seq_len
+    cache_len = max_seq_len + 1
+    slots = settings.max_slots
+    buckets = _buckets(max_seq_len)
+    views = view_buckets_for(max_seq_len)
+    rows_set = (1, slots) if slots > 1 else (1,)
+
+    key = _key_sds()
+    params = jax.eval_shape(functools.partial(init_params, cfg), key)
+    pool = jax.eval_shape(lambda: KVCache.create(
+        cfg, slots, max_seq_len, trash_slot=True, quantize_kv=False))
+
+    def prefill_args(rows: int, bucket: int, plen: int = 0):
+        args = [params, pool,
+                _sds((rows, bucket), jnp.int32),
+                _sds((rows, bucket), jnp.int32),
+                _sds((rows,), jnp.int32), _sds((rows,), jnp.int32),
+                key, _sds((rows,), jnp.float32),
+                _sds((rows,), jnp.int32), _sds((rows,), jnp.float32)]
+        if plen:
+            kv = (cfg.num_layers, plen, cfg.num_kv_heads, cfg.head_dim)
+            args += [_sds(kv, cfg.activation_dtype),
+                     _sds(kv, cfg.activation_dtype)]
+        return args
+
+    prefill = make_prefill_fn(cfg, cache_len)
+    # The auto-prefix splice set: every (plen, suffix bucket, rows) the
+    # quantized registration path can produce — the bounded census
+    # warmup and the worker's background warms walk (engine
+    # prefix_warmup_shapes).
+    plens = auto_prefix_plens(buckets, max_seq_len)
+    splice = [(p, b, r) for p in plens for b in buckets
+              if b <= bucket_for(buckets, max_seq_len - p)
+              for r in rows_set]
+    rep_plen, rep_bucket, rep_rows = splice[-1] if splice \
+        else (16, buckets[0], 1)
+
+    decode = make_decode_fn(cfg, settings.decode_chunk, max_seq_len,
+                            max_seq_len, views[-1])
+    decode_args = [params, pool,
+                   _sds((slots,), jnp.int32), _sds((slots,), jnp.int32),
+                   key, _sds((slots,), jnp.float32),
+                   _sds((slots,), jnp.int32), _sds((slots,), jnp.float32),
+                   _sds((slots,), jnp.int32), _sds((slots,), jnp.int32),
+                   _sds((slots,), jnp.bool_)]
+
+    prefix_build = make_prefix_build_fn(cfg, cache_len)
+
+    def prefix_splice(p, pool_, pk, pv, *rest):
+        return prefill(p, pool_, *rest, pk=pk, pv=pv)
+
+    rest = prefill_args(rep_rows, rep_bucket, plen=rep_plen)
+
+    return [
+        {"component": "serve", "name": "prefill", "fn": prefill,
+         "args": prefill_args(rows_set[-1], buckets[-1]),
+         "signatures": len(buckets) * len(rows_set)},
+        {"component": "serve", "name": "prefill_prefix",
+         "fn": prefix_splice,
+         "args": rest[:2] + rest[-2:] + rest[2:-2],
+         "signatures": len(splice)},
+        {"component": "serve", "name": "decode", "fn": decode,
+         "args": decode_args, "signatures": len(views)},
+        {"component": "serve", "name": "prefix_build", "fn": prefix_build,
+         "args": [params, _sds((1, buckets[-1]), jnp.int32),
+                  _sds((1, buckets[-1]), jnp.int32)],
+         "signatures": len(buckets)},
+    ]
+
+
+def _train_specs(settings: AuditSettings) -> List[dict]:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import (
+        init_params,
+        param_logical_axes,
+    )
+    from runbooks_tpu.parallel.mesh import single_device_mesh
+    from runbooks_tpu.parallel.sharding import tree_shardings
+    from runbooks_tpu.train.lora import (
+        LoraConfig,
+        init_lora,
+        lora_logical_axes,
+        make_lora_train_step,
+    )
+    from runbooks_tpu.train.step import (
+        TrainState,
+        infer_state_shardings,
+        make_train_step,
+    )
+
+    cfg = get_config(settings.config)
+    mesh = single_device_mesh()
+    optimizer = optax.adamw(1e-3)
+    key = _key_sds()
+    batch = {"tokens": _sds((settings.batch, settings.seq), jnp.int32),
+             "targets": _sds((settings.batch, settings.seq), jnp.int32)}
+
+    def init_fn(rng):
+        params = init_params(cfg, rng)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=optimizer.init(params))
+
+    state = jax.eval_shape(init_fn, key)
+    shardings = infer_state_shardings(param_logical_axes(cfg), state, mesh)
+    step = make_train_step(cfg, optimizer, mesh, shardings)
+
+    lcfg = LoraConfig(rank=4)
+    base = state.params
+    base_shardings = tree_shardings(base, param_logical_axes(cfg), mesh)
+
+    def lora_init_fn(rng):
+        lora = init_lora(base, lcfg, rng)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=lora,
+                          opt_state=optimizer.init(lora))
+
+    lstate = jax.eval_shape(lora_init_fn, key)
+    laxes = lora_logical_axes(lcfg, lstate.params)
+    lshardings = infer_state_shardings(laxes, lstate, mesh)
+    lstep = make_lora_train_step(cfg, lcfg, optimizer, mesh, lshardings,
+                                 base_shardings)
+
+    return [
+        {"component": "train", "name": "train_step", "fn": step,
+         "args": [state, batch], "signatures": 1},
+        {"component": "train", "name": "lora_step", "fn": lstep,
+         "args": [lstate, base, batch], "signatures": 1},
+    ]
+
+
+def audit_programs(
+    settings: Optional[AuditSettings] = None,
+) -> Tuple[dict, List[Finding]]:
+    """Trace and audit the full registered program set. Returns
+    (census, findings). The census is the committed-baseline content:
+    per program, its signature cardinality and content flags."""
+    import jax
+
+    settings = settings or AuditSettings()
+    findings: List[Finding] = []
+    programs: List[dict] = []
+    for spec in _engine_specs(settings) + _train_specs(settings):
+        program = f"{spec['component']}/{spec['name']}"
+        try:
+            closed = jax.make_jaxpr(spec["fn"])(*spec["args"])
+        except Exception as exc:  # noqa: BLE001 — surface, don't crash
+            findings.append(Finding(
+                rule="program-trace", path=f"program:{program}", line=0,
+                message=f"abstract trace failed: {exc!r}"))
+            programs.append({"component": spec["component"],
+                             "name": spec["name"],
+                             "signatures": spec["signatures"],
+                             "flags": None})
+            continue
+        prog_findings, flags = audit_jaxpr(closed, program, settings)
+        findings.extend(prog_findings)
+        programs.append({"component": spec["component"],
+                         "name": spec["name"],
+                         "signatures": spec["signatures"],
+                         "flags": flags})
+    census = {
+        "settings": {"config": settings.config,
+                     "max_slots": settings.max_slots,
+                     "decode_chunk": settings.decode_chunk,
+                     "batch": settings.batch, "seq": settings.seq},
+        "programs": programs,
+    }
+    return census, findings
+
+
+# ---------------------------------------------------------------------------
+# Census baseline (config/program_baseline.json)
+# ---------------------------------------------------------------------------
+
+def load_program_baseline(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_program_baseline(path: str, census: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(census, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def diff_census(census: dict, baseline: Optional[dict],
+                baseline_path: str) -> List[Finding]:
+    """Census drift findings (rule ``program-census-drift``), mirroring
+    the metrics-catalog drift gate: additions, removals, and signature/
+    flag changes all fail until the committed baseline is regenerated."""
+    hint = (f"; regenerate {os.path.basename(baseline_path)} with "
+            "`rbt check --write-baseline` if intentional")
+    if baseline is None:
+        return [Finding(
+            rule="program-census-drift", path=baseline_path, line=0,
+            message="program baseline missing" + hint)]
+    findings: List[Finding] = []
+    if baseline.get("settings") != census["settings"]:
+        findings.append(Finding(
+            rule="program-census-drift", path=baseline_path, line=0,
+            message=f"audit settings changed: baseline "
+                    f"{baseline.get('settings')} vs "
+                    f"{census['settings']}" + hint))
+    def by_name(c):
+        return {(p["component"], p["name"]): p
+                for p in c.get("programs", [])}
+    base, cur = by_name(baseline), by_name(census)
+    for key in sorted(set(base) | set(cur)):
+        name = "/".join(key)
+        b, c = base.get(key), cur.get(key)
+        if b is None:
+            findings.append(Finding(
+                rule="program-census-drift", path=baseline_path, line=0,
+                message=f"new program {name} not in baseline" + hint))
+        elif c is None:
+            findings.append(Finding(
+                rule="program-census-drift", path=baseline_path, line=0,
+                message=f"program {name} vanished from the census" + hint))
+        elif (b.get("signatures") != c["signatures"]
+              or b.get("flags") != c["flags"]):
+            findings.append(Finding(
+                rule="program-census-drift", path=baseline_path, line=0,
+                message=f"program {name} drifted: baseline "
+                        f"signatures={b.get('signatures')} "
+                        f"flags={b.get('flags')} vs "
+                        f"signatures={c['signatures']} "
+                        f"flags={c['flags']}" + hint))
+    return findings

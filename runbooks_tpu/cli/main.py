@@ -15,6 +15,7 @@ dependency-friendly order (Dataset, Model, Server, Notebook).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import re
@@ -880,6 +881,55 @@ def cmd_suspend(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Static program & concurrency audit (docs/static-analysis.md):
+    AST lint for the recurring concurrency/precision defect classes plus
+    an abstract-trace audit of the registered hot programs — zero XLA
+    backend compiles, so it runs in CI in seconds (`make check`)."""
+    from runbooks_tpu.analysis.check import run_check
+
+    report = run_check(programs=not args.no_programs,
+                       lint=not args.no_lint,
+                       write_baseline=args.write_baseline)
+    if args.json:
+        print(json.dumps({
+            "active": [f.as_dict() for f in report.active],
+            "suppressed": [f.as_dict() for f in report.suppressed],
+            "stale": [dataclasses.asdict(s) for s in report.stale],
+            "census": report.census,
+            "compiles": report.compiles,
+            "monitoring": report.monitoring,
+            "seconds": round(report.seconds, 2),
+        }, indent=2))
+    else:
+        for f in report.active:
+            print(f.render())
+        for s in report.stale:
+            print(f"stale suppression: [{s.rule}] {s.path} "
+                  f"({s.reason})")
+        programs = ((report.census or {}).get("programs", [])
+                    if report.census else [])
+        compiles = (f"{report.compiles} backend compiles"
+                    if report.monitoring
+                    else "compiles UNVERIFIED (no jax.monitoring)")
+        print(f"rbt check: {len(report.active)} active, "
+              f"{len(report.suppressed)} suppressed, "
+              f"{len(report.stale)} stale; "
+              f"{len(programs)} programs audited, "
+              f"{compiles}, "
+              f"{report.seconds:.1f}s")
+        if args.write_baseline and not args.no_programs:
+            print("program baseline regenerated "
+                  "(config/program_baseline.json); review and commit it")
+    rc = report.exit_code(strict=args.strict)
+    if args.strict and args.budget_s and report.seconds > args.budget_s:
+        print(f"rbt check: wall time {report.seconds:.1f}s exceeded the "
+              f"--budget-s {args.budget_s:.0f}s budget — the audit must "
+              "stay cheap enough to gate every CI run", file=sys.stderr)
+        rc = rc or 5
+    return rc
+
+
 def _inprocess_port_forward(client, namespace: str, pod: str,
                             local: int, remote: int) -> Optional[int]:
     """Pod port-forward over the Kubernetes websocket subresource — no
@@ -1045,6 +1095,28 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("suspend", help="suspend a notebook")
     sp.add_argument("scope")
     sp.set_defaults(func=cmd_suspend)
+
+    sp = sub.add_parser(
+        "check",
+        help="static program & concurrency audit (lint + jaxpr contracts)")
+    sp.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline suppressions, any "
+                         "backend compile during the audit, and a blown "
+                         "--budget-s")
+    sp.add_argument("--write-baseline", action="store_true",
+                    help="regenerate config/program_baseline.json from "
+                         "the current program census instead of diffing "
+                         "against it")
+    sp.add_argument("--no-programs", action="store_true",
+                    help="skip the jaxpr program-contract side")
+    sp.add_argument("--no-lint", action="store_true",
+                    help="skip the AST lint side")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    sp.add_argument("--budget-s", type=float, default=0.0,
+                    help="with --strict: fail if the audit takes longer "
+                         "than this many seconds (CI wall-time budget)")
+    sp.set_defaults(func=cmd_check)
     return p
 
 

@@ -77,7 +77,7 @@ class FleetState:
 
     def __init__(self):
         self._lock = threading.RLock()
-        self._workloads: Dict[WorkloadKey, Dict[str, ReplicaSample]] = {}
+        self._workloads: Dict[WorkloadKey, Dict[str, ReplicaSample]] = {}  # guarded-by: _lock
 
     def reset(self) -> None:
         with self._lock:

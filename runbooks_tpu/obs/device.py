@@ -85,19 +85,19 @@ class CompileSentinel:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._installed = False
-        self._degraded: Optional[str] = None
+        self._installed = False                 # guarded-by: _lock
+        self._degraded: Optional[str] = None    # guarded-by: _lock
         # component -> number of live claimants. Counted, not boolean:
         # two engines in one process both mark "serve"; the first one
         # stopping must not blind the sentinel for the survivor.
-        self._steady: Dict[str, int] = {}
+        self._steady: Dict[str, int] = {}       # guarded-by: _lock
         self._local = threading.local()
-        self.total = 0
-        self.unexpected = 0
-        self.compile_seconds = 0.0
+        self.total = 0                          # guarded-by: _lock
+        self.unexpected = 0                     # guarded-by: _lock
+        self.compile_seconds = 0.0              # guarded-by: _lock
         # Ring of the most recent unexpected-compile records (operators
         # read it via /debug/programs; tests assert on it).
-        self.last_unexpected: List[dict] = []
+        self.last_unexpected: List[dict] = []   # guarded-by: _lock
 
     # -- lifecycle ------------------------------------------------------
 
@@ -229,11 +229,11 @@ class ProgramTracker:
         self._lock = threading.Lock()
         # (component, name) ->
         #   {"fn_ref": weakref-to-jitted-fn | None, "costs": {sig: cost}}
-        self._programs: Dict[Tuple[str, str], dict] = {}
+        self._programs: Dict[Tuple[str, str], dict] = {}  # guarded-by: _lock
         # (registry id, component) -> program names last exported there,
         # so set_gauges can DROP series whose program died/re-registered
         # instead of leaving a dead model's numbers on the exposition.
-        self._exported: Dict[Tuple[int, Optional[str]], set] = {}
+        self._exported: Dict[Tuple[int, Optional[str]], set] = {}  # guarded-by: _lock
 
     @staticmethod
     def _make_ref(fn: Any):

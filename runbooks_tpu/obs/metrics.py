@@ -193,10 +193,10 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: Dict[MetricKey, float] = defaultdict(float)
-        self._gauges: Dict[MetricKey, object] = {}
-        self._hists: Dict[MetricKey, _Histogram] = {}
-        self._help: Dict[str, str] = {}
+        self._counters: Dict[MetricKey, float] = defaultdict(float)  # guarded-by: _lock
+        self._gauges: Dict[MetricKey, object] = {}                   # guarded-by: _lock
+        self._hists: Dict[MetricKey, _Histogram] = {}                # guarded-by: _lock
+        self._help: Dict[str, str] = {}                              # guarded-by: _lock
         self.started = time.time()
 
     # -- write side ----------------------------------------------------

@@ -34,11 +34,12 @@ class Profiler:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._active_dir: Optional[str] = None
+        self._active_dir: Optional[str] = None  # guarded-by: _lock
 
     @property
     def active_dir(self) -> Optional[str]:
-        return self._active_dir
+        with self._lock:
+            return self._active_dir
 
     def start(self, log_dir: str) -> str:
         import jax

@@ -68,10 +68,10 @@ def _max_trace_bytes() -> int:
 class _Writer:
     def __init__(self):
         self._lock = threading.Lock()
-        self._path: Optional[str] = None
-        self._file = None
-        self._bytes = 0
-        self._max_bytes = 0
+        self._path: Optional[str] = None   # guarded-by: _lock
+        self._file = None                  # guarded-by: _lock
+        self._bytes = 0                    # guarded-by: _lock
+        self._max_bytes = 0                # guarded-by: _lock
 
     def configure(self, path: Optional[str]) -> None:
         with self._lock:
@@ -128,7 +128,7 @@ class _Writer:
             except OSError:
                 pass
 
-    def _rotate_locked(self) -> None:
+    def _rotate_locked(self) -> None:  # guarded-by: _lock
         """Size cap hit: roll the live file to <path>.1 (replacing the
         previous generation) and start fresh. Caller holds the lock; the
         open failure mode matches write() — drop and retry later."""

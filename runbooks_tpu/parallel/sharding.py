@@ -162,7 +162,7 @@ def _current_mesh():
         if mesh is not None and not mesh.empty:
             return mesh
     except Exception:
-        pass
+        pass  # API absent on older jax; fall through to the legacy probe
     try:
         from jax._src.mesh import thread_resources
 

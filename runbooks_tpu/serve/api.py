@@ -198,9 +198,9 @@ class EngineWorker:
         # v5e relay). Servers started with warmup+warm_prefix pre-compile
         # the builder per bucket and never hit it.
         self._warn_cold_prefix = warn_cold_prefix
-        self._pending: list[Tuple[Request, Future]] = []
-        self._inflight: list[Tuple[Request, Future]] = []
-        self._prefix_jobs: list[Tuple[list, Future]] = []
+        self._pending: list[Tuple[Request, Future]] = []      # guarded-by: _lock
+        self._inflight: list[Tuple[Request, Future]] = []     # guarded-by: _lock
+        self._prefix_jobs: list[Tuple[list, Future]] = []     # guarded-by: _lock
         self._prefix_warm_queue: list[tuple] = []
         self._prefix_warm_buffers = None  # threaded through warm calls
         # (plen, bucket, rows) shapes already executed once: XLA keys
@@ -309,30 +309,36 @@ class EngineWorker:
                 self.engine.step()
                 if self._prefix_warm_queue:
                     self._warm_one()
-                done = [(r, f) for r, f in self._inflight if r.finished]
-                if done:
-                    self._inflight = [(r, f) for r, f in self._inflight
-                                      if not r.finished]
-                    for req, fut in done:
-                        if req.auto_prefix and req._slot >= 0:
-                            # Multi-turn chat: lift the prompt's KV out of
-                            # the slot before the next admission can
-                            # recycle it (safe here: admissions happen at
-                            # the next step(), and this thread owns the
-                            # engine). Zero forward passes.
-                            try:
-                                plen = self.engine.register_prefix_from_slot(
-                                    req._slot, req.prompt_tokens)
-                                if plen:
-                                    key = tuple(
-                                        int(t)
-                                        for t in req.prompt_tokens[:plen])
-                                    self._queue_warm(key, plen)
-                            except Exception as exc:  # noqa: BLE001
-                                print(f"serve: auto-prefix registration "
-                                      f"failed: {exc!r}", flush=True)
-                        if not fut.done():
-                            fut.set_result(req)
+                # Under the lock: drain() (HTTP thread) and the crash
+                # handler both read _inflight concurrently, and the
+                # reshuffle below is a read-then-replace, not an atomic
+                # swap (`rbt check` lock-discipline caught this).
+                with self._lock:
+                    done = [(r, f) for r, f in self._inflight
+                            if r.finished]
+                    if done:
+                        self._inflight = [(r, f) for r, f in self._inflight
+                                          if not r.finished]
+                for req, fut in done:
+                    if req.auto_prefix and req._slot >= 0:
+                        # Multi-turn chat: lift the prompt's KV out of
+                        # the slot before the next admission can
+                        # recycle it (safe here: admissions happen at
+                        # the next step(), and this thread owns the
+                        # engine). Zero forward passes.
+                        try:
+                            plen = self.engine.register_prefix_from_slot(
+                                req._slot, req.prompt_tokens)
+                            if plen:
+                                key = tuple(
+                                    int(t)
+                                    for t in req.prompt_tokens[:plen])
+                                self._queue_warm(key, plen)
+                        except Exception as exc:  # noqa: BLE001
+                            print(f"serve: auto-prefix registration "
+                                  f"failed: {exc!r}", flush=True)
+                    if not fut.done():
+                        fut.set_result(req)
             except Exception as exc:  # noqa: BLE001 — engine step blew up
                 # Fail every waiting request AND queued prefix job with
                 # the error (hanging futures would wedge HTTP handlers
@@ -1059,7 +1065,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         if not drained:
             print(f"serve: drain timed out after {drain_timeout_s}s; "
                   "abandoning remaining requests", flush=True)
-        worker.stop()
+        # stop() joins the worker thread (up to 5 s) — off the loop too,
+        # or the join stalls the final SSE flushes it is waiting behind
+        # (`rbt check` async-blocking caught the inline version).
+        await asyncio.get_running_loop().run_in_executor(None, worker.stop)
 
     app.on_cleanup.append(on_cleanup)
     return app

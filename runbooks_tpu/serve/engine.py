@@ -38,7 +38,6 @@ inference is in-framework and TPU-shaped:
 from __future__ import annotations
 
 import dataclasses
-import functools
 import time
 from typing import Any, Callable, List, Optional
 
@@ -130,6 +129,178 @@ def _buckets(max_prefill: int) -> List[int]:
         b *= 2
     out.append(max_prefill)
     return out
+
+
+def bucket_for(buckets: List[int], n: int) -> int:
+    """Smallest bucket covering n tokens (last bucket when none do)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def view_buckets_for(max_seq_len: int) -> List[int]:
+    """Decode cache-view buckets for a given context window (see the
+    view discussion in InferenceEngine.__init__)."""
+    return sorted({v for v in (256, 1024) if v < max_seq_len}
+                  | {max_seq_len})
+
+
+def auto_prefix_plens(buckets: List[int], max_seq_len: int) -> List[int]:
+    """The bounded prefix lengths the quantized (auto_prefix) path can
+    register: prefill buckets that leave >= 16 prompt tokens. The
+    compiled splice-program census is keyed on these (static-analysis
+    and warmup both walk this set)."""
+    return [b for b in buckets if b <= max_seq_len - 16]
+
+
+# ---------------------------------------------------------------------------
+# Jitted program bodies, as module-level factories.
+#
+# The engine jits these in __init__; `rbt check` (runbooks_tpu/analysis/
+# program.py) traces the same factories ABSTRACTLY (jax.make_jaxpr over
+# ShapeDtypeStructs — zero device arrays, zero backend compiles) to audit
+# the steady-state program set for host callbacks, silent dtype
+# promotions, embedded constants, and census drift. Keeping one body
+# shared by both is what makes the audit honest: the engine cannot ship
+# a program the auditor never saw.
+# ---------------------------------------------------------------------------
+
+
+def make_prefill_fn(cfg: ModelConfig, cache_len: int):
+    """Batched prefill + splice + first-token sample (one jit dispatch
+    per admission group). See the inline commentary for the invariants;
+    pk/pv (when given) splice a registered shared prefix into every
+    scratch row first."""
+
+    def prefill_fn(params, pool, tokens, positions, slots,
+                   last_pos, rng, temps, top_ks, top_ps,
+                   pk=None, pv=None):
+        # Prefill `rows` requests into fresh zero rows at once, then
+        # splice each row into the pool cache (donated => in-place, no
+        # full-cache copy). Stale data from a slot's previous occupant
+        # needs no clearing: this request's queries only ever attend
+        # slots <= their own position, all of which this prefill/decode
+        # has (re)written. Padding rows (beyond the real requests)
+        # carry slots[0] as their destination; the splice loop runs in
+        # DESCENDING row order so the real row 0 is written last and
+        # overwrites any padding garbage at that slot.
+        #
+        # First-token sampling lives INSIDE the jit: an eager sampling
+        # chain here compiled ~20 tiny relay programs at the first
+        # admission (~27 s of TTFT, measured) that warmup never hit.
+        # One dispatch also means one host round-trip per admission
+        # group. rng advances functionally (split in, successor out).
+        rows = tokens.shape[0]
+        row_shape = (cfg.num_layers, rows, cache_len, cfg.num_kv_heads,
+                     cfg.head_dim)
+        # Scratch rows stay in the activation dtype even when the pool
+        # is int8: prefill attention then runs at full precision, and
+        # each row is quantized exactly once at the splice below.
+        k1 = jnp.zeros(row_shape, cfg.activation_dtype)
+        v1 = jnp.zeros(row_shape, cfg.activation_dtype)
+        if pk is not None:
+            # Shared-prefix reuse: the registered prefix's K/V
+            # [L, plen, kv_h, d] lands in slots [0, plen) of every
+            # scratch row (exact length — no pad keys a suffix query
+            # could wrongly attend), and `tokens` holds only the
+            # SUFFIX, positions starting at plen.
+            plen = pk.shape[1]
+            k1 = k1.at[:, :, :plen].set(
+                pk[:, None].astype(cfg.activation_dtype))
+            v1 = v1.at[:, :, :plen].set(
+                pv[:, None].astype(cfg.activation_dtype))
+        cache1 = KVCache(k=k1, v=v1, index=jnp.zeros((), jnp.int32))
+        logits, cache1 = forward(cfg, params, tokens,
+                                 positions=positions, cache=cache1)
+        if pool.k.dtype == jnp.int8:
+            from runbooks_tpu.ops.quantization import quantize_kv
+
+            rows_k, rows_ks = quantize_kv(cache1.k)
+            rows_v, rows_vs = quantize_kv(cache1.v)
+        else:
+            rows_k, rows_v, rows_ks, rows_vs = (cache1.k, cache1.v,
+                                                None, None)
+        new_k, new_v = pool.k, pool.v
+        new_ks, new_vs = pool.k_scale, pool.v_scale
+        for r in range(rows - 1, -1, -1):
+            new_k = jax.lax.dynamic_update_slice_in_dim(
+                new_k, rows_k[:, r:r + 1], slots[r], axis=1)
+            new_v = jax.lax.dynamic_update_slice_in_dim(
+                new_v, rows_v[:, r:r + 1], slots[r], axis=1)
+            if rows_ks is not None:
+                new_ks = jax.lax.dynamic_update_slice_in_dim(
+                    new_ks, rows_ks[:, r:r + 1], slots[r], axis=1)
+                new_vs = jax.lax.dynamic_update_slice_in_dim(
+                    new_vs, rows_vs[:, r:r + 1], slots[r], axis=1)
+        rng, sub = jax.random.split(rng)
+        last_logits = jnp.take_along_axis(
+            logits, last_pos[:, None, None], axis=1)[:, 0]
+        first = sample(last_logits, sub, temps, top_ks, top_ps)
+        new_pool = KVCache(k=new_k, v=new_v, index=pool.index,
+                           k_scale=new_ks, v_scale=new_vs)
+        return first, new_pool, rng
+
+    return prefill_fn
+
+
+def make_prefix_build_fn(cfg: ModelConfig, cache_len: int):
+    """Prefix-KV builder: one full bucket-width row; the caller slices
+    to the actual prefix length eagerly. Keeping plen OUT of the jit key
+    means one compiled program per bucket — a bounded set
+    warmup(prefix_build=True) can pre-compile, so a runtime /v1/prefix
+    registration never compiles on the serving worker thread (a cold
+    compile there stalls every stream)."""
+
+    def prefix_build_fn(params, tokens, positions):
+        row_shape = (cfg.num_layers, 1, cache_len, cfg.num_kv_heads,
+                     cfg.head_dim)
+        c1 = KVCache(k=jnp.zeros(row_shape, cfg.activation_dtype),
+                     v=jnp.zeros(row_shape, cfg.activation_dtype),
+                     index=jnp.zeros((), jnp.int32))
+        _, c1 = forward(cfg, params, tokens, positions=positions,
+                        cache=c1)
+        return c1.k[:, 0], c1.v[:, 0]
+
+    return prefix_build_fn
+
+
+def make_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
+                   pad_slot: int, view: int):
+    """`chunk` decode steps in one jit call (lax.scan). Per-slot
+    liveness is tracked ON DEVICE with exactly the host's finish rules
+    (EOS, max_tokens budget, cache out-of-room), so the host can replay
+    (tokens, valid) afterwards and land in the same slot state as
+    chunk=1 step-at-a-time would. rng advances functionally (successor
+    key returned) — no eager split on the host per chunk."""
+
+    def decode_fn(params, cache, tokens, positions, rng,
+                  temperature, top_k, top_p, eos_ids, remaining, active):
+        rng, step_rng = jax.random.split(rng)
+        keys = jax.random.split(step_rng, chunk)
+
+        def body(carry, key):
+            cache, tok, pos, alive, emitted = carry
+            p = jnp.where(alive, pos, pad_slot)
+            logits, cache = forward(cfg, params, tok[:, None],
+                                    positions=p[:, None], cache=cache,
+                                    cache_view=view)
+            nxt = sample(logits[:, -1], key, temperature, top_k, top_p)
+            nxt = jnp.where(alive, nxt, tok)
+            out = (nxt, alive)
+            emitted = emitted + alive
+            pos = pos + alive
+            hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
+            alive = (alive & ~hit_eos & (emitted < remaining)
+                     & (pos < max_len))
+            return (cache, nxt, pos, alive, emitted), out
+
+        init = (cache, tokens, positions, active,
+                jnp.zeros_like(remaining))
+        (cache, *_), (toks, valid) = jax.lax.scan(body, init, keys)
+        return toks, valid, cache, rng
+
+    return decode_fn
 
 
 class InferenceEngine:
@@ -286,74 +457,7 @@ class InferenceEngine:
 
         cache_len = self.max_seq_len + 1
 
-        def prefill_fn(params, pool, tokens, positions, slots,
-                       last_pos, rng, temps, top_ks, top_ps,
-                       pk=None, pv=None):
-            # Prefill `rows` requests into fresh zero rows at once, then
-            # splice each row into the pool cache (donated => in-place, no
-            # full-cache copy). Stale data from a slot's previous occupant
-            # needs no clearing: this request's queries only ever attend
-            # slots <= their own position, all of which this prefill/decode
-            # has (re)written. Padding rows (beyond the real requests)
-            # carry slots[0] as their destination; the splice loop runs in
-            # DESCENDING row order so the real row 0 is written last and
-            # overwrites any padding garbage at that slot.
-            #
-            # First-token sampling lives INSIDE the jit: an eager sampling
-            # chain here compiled ~20 tiny relay programs at the first
-            # admission (~27 s of TTFT, measured) that warmup never hit.
-            # One dispatch also means one host round-trip per admission
-            # group. rng advances functionally (split in, successor out).
-            rows = tokens.shape[0]
-            row_shape = (cfg.num_layers, rows, cache_len, cfg.num_kv_heads,
-                         cfg.head_dim)
-            # Scratch rows stay in the activation dtype even when the pool
-            # is int8: prefill attention then runs at full precision, and
-            # each row is quantized exactly once at the splice below.
-            k1 = jnp.zeros(row_shape, cfg.activation_dtype)
-            v1 = jnp.zeros(row_shape, cfg.activation_dtype)
-            if pk is not None:
-                # Shared-prefix reuse: the registered prefix's K/V
-                # [L, plen, kv_h, d] lands in slots [0, plen) of every
-                # scratch row (exact length — no pad keys a suffix query
-                # could wrongly attend), and `tokens` holds only the
-                # SUFFIX, positions starting at plen.
-                plen = pk.shape[1]
-                k1 = k1.at[:, :, :plen].set(
-                    pk[:, None].astype(cfg.activation_dtype))
-                v1 = v1.at[:, :, :plen].set(
-                    pv[:, None].astype(cfg.activation_dtype))
-            cache1 = KVCache(k=k1, v=v1, index=jnp.zeros((), jnp.int32))
-            logits, cache1 = forward(cfg, params, tokens,
-                                     positions=positions, cache=cache1)
-            if pool.k.dtype == jnp.int8:
-                from runbooks_tpu.ops.quantization import quantize_kv
-
-                rows_k, rows_ks = quantize_kv(cache1.k)
-                rows_v, rows_vs = quantize_kv(cache1.v)
-            else:
-                rows_k, rows_v, rows_ks, rows_vs = (cache1.k, cache1.v,
-                                                    None, None)
-            new_k, new_v = pool.k, pool.v
-            new_ks, new_vs = pool.k_scale, pool.v_scale
-            for r in range(rows - 1, -1, -1):
-                new_k = jax.lax.dynamic_update_slice_in_dim(
-                    new_k, rows_k[:, r:r + 1], slots[r], axis=1)
-                new_v = jax.lax.dynamic_update_slice_in_dim(
-                    new_v, rows_v[:, r:r + 1], slots[r], axis=1)
-                if rows_ks is not None:
-                    new_ks = jax.lax.dynamic_update_slice_in_dim(
-                        new_ks, rows_ks[:, r:r + 1], slots[r], axis=1)
-                    new_vs = jax.lax.dynamic_update_slice_in_dim(
-                        new_vs, rows_vs[:, r:r + 1], slots[r], axis=1)
-            rng, sub = jax.random.split(rng)
-            last_logits = jnp.take_along_axis(
-                logits, last_pos[:, None, None], axis=1)[:, 0]
-            first = sample(last_logits, sub, temps, top_ks, top_ps)
-            new_pool = KVCache(k=new_k, v=new_v, index=pool.index,
-                               k_scale=new_ks, v_scale=new_vs)
-            return first, new_pool, rng
-
+        prefill_fn = make_prefill_fn(cfg, cache_len)
         self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
         # Same body with the prefix splice live (jit specializes per
         # (plen, suffix-bucket, rows) shape; registrations are rare and
@@ -366,23 +470,7 @@ class InferenceEngine:
         obs_device.PROGRAMS.register("serve", "prefill_prefix",
                                      self._prefill_prefix)
 
-        def prefix_build_fn(params, tokens, positions):
-            # Returns the full bucket-width row; the caller slices to the
-            # actual prefix length eagerly. Keeping plen OUT of the jit
-            # key means one compiled program per bucket — a bounded set
-            # warmup(prefix_build=True) can pre-compile, so a runtime
-            # /v1/prefix registration never compiles on the serving
-            # worker thread (a cold compile there stalls every stream).
-            row_shape = (cfg.num_layers, 1, cache_len, cfg.num_kv_heads,
-                         cfg.head_dim)
-            c1 = KVCache(k=jnp.zeros(row_shape, cfg.activation_dtype),
-                         v=jnp.zeros(row_shape, cfg.activation_dtype),
-                         index=jnp.zeros((), jnp.int32))
-            _, c1 = forward(cfg, params, tokens, positions=positions,
-                            cache=c1)
-            return c1.k[:, 0], c1.v[:, 0]
-
-        self._prefix_build = jax.jit(prefix_build_fn)
+        self._prefix_build = jax.jit(make_prefix_build_fn(cfg, cache_len))
         obs_device.PROGRAMS.register("serve", "prefix_build",
                                      self._prefix_build)
 
@@ -394,48 +482,15 @@ class InferenceEngine:
         # bandwidth-bound, and low occupancy shouldn't pay for streaming
         # the whole max-length cache. One compiled program per view bucket;
         # writes (incl. trash-slot parking) always target the full cache.
-        self.view_buckets = sorted(
-            {v for v in (256, 1024) if v < self.max_seq_len}
-            | {self.max_seq_len})
+        self.view_buckets = view_buckets_for(self.max_seq_len)
         self._decode_fns: dict = {}
-
-        def decode_fn(view, params, cache, tokens, positions, rng,
-                      temperature, top_k, top_p, eos_ids, remaining, active):
-            # `chunk` decode steps in one jit call (lax.scan). Per-slot
-            # liveness is tracked ON DEVICE with exactly the host's finish
-            # rules (EOS, max_tokens budget, cache out-of-room), so the
-            # host can replay (tokens, valid) afterwards and land in the
-            # same slot state as chunk=1 step-at-a-time would. rng advances
-            # functionally (successor key returned) — no eager split on the
-            # host per chunk.
-            rng, step_rng = jax.random.split(rng)
-            keys = jax.random.split(step_rng, chunk)
-
-            def body(carry, key):
-                cache, tok, pos, alive, emitted = carry
-                p = jnp.where(alive, pos, self._pad_slot)
-                logits, cache = forward(cfg, params, tok[:, None],
-                                        positions=p[:, None], cache=cache,
-                                        cache_view=view)
-                nxt = sample(logits[:, -1], key, temperature, top_k, top_p)
-                nxt = jnp.where(alive, nxt, tok)
-                out = (nxt, alive)
-                emitted = emitted + alive
-                pos = pos + alive
-                hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
-                alive = (alive & ~hit_eos & (emitted < remaining)
-                         & (pos < max_len))
-                return (cache, nxt, pos, alive, emitted), out
-
-            init = (cache, tokens, positions, active,
-                    jnp.zeros_like(remaining))
-            (cache, *_), (toks, valid) = jax.lax.scan(body, init, keys)
-            return toks, valid, cache, rng
 
         def decode_for(view: int):
             if view not in self._decode_fns:
                 self._decode_fns[view] = jax.jit(
-                    functools.partial(decode_fn, view), donate_argnums=(1,))
+                    make_decode_fn(cfg, chunk, max_len, self._pad_slot,
+                                   view),
+                    donate_argnums=(1,))
                 obs_device.PROGRAMS.register("serve", f"decode_v{view}",
                                              self._decode_fns[view])
             return self._decode_fns[view]
@@ -822,10 +877,7 @@ class InferenceEngine:
                 if not self.active[i] and i not in exclude]
 
     def _bucket_for(self, n: int) -> int:
-        for b in self.prefill_buckets:
-            if n <= b:
-                return b
-        return self.prefill_buckets[-1]
+        return bucket_for(self.prefill_buckets, n)
 
     def _admit(self, exclude_slots=()) -> None:
         budget = self.prefill_budget
@@ -942,6 +994,7 @@ class InferenceEngine:
             else:
                 first, self.cache, self.rng = self._prefill(
                     self.params, self.cache, *args)
+            # rbt-check: ignore[device-sync] prefill dispatch boundary — the first token must reach the host to stream
             first = np.asarray(first)
         # Labeled by (bucket, rows): the two row shapes are different
         # compiled programs with ~rows-proportional FLOPs, and the
@@ -1087,7 +1140,9 @@ class InferenceEngine:
                 jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
                 jnp.asarray(eos_ids), jnp.asarray(remaining),
                 jnp.asarray(self.active))
+            # rbt-check: ignore[device-sync] decode-chunk dispatch boundary: one sync per chunk, not per token
             toks = np.asarray(toks)          # [chunk, slots]
+            # rbt-check: ignore[device-sync] same boundary — valid rides the same chunk sync
             valid = np.asarray(valid)        # [chunk, slots] bool
         obs_metrics.REGISTRY.observe(
             "serve_decode_dispatch_seconds",
