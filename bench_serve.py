@@ -16,6 +16,15 @@ per-slot-per-head scales — the serving fast path. The JSON reports
 weight_bytes and kv_cache_bytes next to decode tok/s and TTFT so the
 bandwidth-for-throughput trade is auditable (decode is memory-bound:
 fewer bytes streamed per token = more tok/s at equal batch).
+
+RBT_BENCH_PAGED=1 runs the paged-KV capacity axis instead
+(docs/paged-kv.md): a shared-system-prompt workload against the dense
+slot pool, then against the paged engine sized to the SAME (or fewer)
+KV HBM bytes, reporting peak concurrent sequences and decode tok/s for
+both plus the radix-sharing counters. Acceptance: the paged engine
+sustains >= 2x the dense concurrency at equal KV HBM
+(value = concurrency ratio, vs_baseline = ratio / 2) with zero
+unexpected XLA compiles across its steady loop.
 """
 
 from __future__ import annotations
@@ -26,6 +35,131 @@ import statistics
 import sys
 import threading
 import time
+
+
+def paged_inner() -> None:
+    """Dense-vs-paged capacity at equal KV HBM on a shared-prefix load.
+
+    Both engines serve the SAME workload — n_requests greedy requests
+    whose prompts share a prefix_len-token system prompt — driven by a
+    direct step loop so peak concurrency is observable. The paged pool
+    is sized to the dense pool's byte budget (num_pages = dense KV bytes
+    // bytes-per-page, i.e. never MORE HBM), so the concurrency ratio is
+    pure paging + radix sharing, not a bigger cache."""
+    import jax
+    import numpy as np
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.obs import device as obs_device
+    from runbooks_tpu.serve.engine import InferenceEngine, Request
+    from runbooks_tpu.serve.paging import PagedInferenceEngine, PagePool
+
+    device = jax.devices()[0]
+    on_tpu = ("tpu" in jax.default_backend().lower()
+              or "TPU" in str(device))
+    model = os.environ.get("RBT_BENCH_MODEL",
+                           "bench-410m" if on_tpu else "debug")
+    dense_slots = int(os.environ.get("RBT_BENCH_SLOTS", 4))
+    max_seq = int(os.environ.get("RBT_BENCH_MAXSEQ", 128))
+    page_size = int(os.environ.get("RBT_BENCH_PAGE_SIZE", 16))
+    prompt_len = int(os.environ.get("RBT_BENCH_PROMPT", 64))
+    prefix_len = int(os.environ.get("RBT_BENCH_PREFIX", 48))
+    max_tokens = int(os.environ.get("RBT_BENCH_MAXTOK", 16))
+    # Enough load to saturate either pool; the paged slot count is an
+    # upper bound, not the capacity claim — pages gate admission.
+    paged_slots = 4 * dense_slots
+    n_requests = paged_slots
+
+    cfg = get_config(model, param_dtype="bfloat16")
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, prefix_len).tolist()
+    prompts = [shared + rng.integers(
+        1, cfg.vocab_size, prompt_len - prefix_len).tolist()
+        for _ in range(n_requests)]
+
+    def run_workload(engine):
+        reqs = [Request(prompt_tokens=list(p), max_tokens=max_tokens,
+                        temperature=0.0) for p in prompts]
+        for r in reqs:
+            engine.submit(r)
+        peak = 0
+        t0 = time.perf_counter()
+        for _ in range(200000):
+            engine.step()
+            peak = max(peak, int(engine.active.sum()))
+            if all(r.finished for r in reqs):
+                break
+        else:
+            raise RuntimeError("paged bench workload did not converge")
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return reqs, peak, wall, toks
+
+    # -- dense baseline ------------------------------------------------
+    dense = InferenceEngine(cfg, params, max_slots=dense_slots,
+                            max_seq_len=max_seq, max_queue=n_requests)
+    dense_kv_bytes = sum(
+        x.nbytes for x in (dense.cache.k, dense.cache.v,
+                           dense.cache.k_scale, dense.cache.v_scale)
+        if x is not None)
+    # Register BEFORE warmup: registration compiles the prefix builder
+    # + splice shapes, and pre-steady they are ordinary startup compiles
+    # (a post-warmup registration is the documented cold-prefix stall —
+    # docs/troubleshooting.md). warmup() keeps the prefix cache.
+    dense.register_prefix(shared)  # the single-prefix auto_prefix path
+    dense.warmup()
+    _, dense_peak, dense_wall, dense_toks = run_workload(dense)
+    # Drop the dense engine's process-wide steady claim before building
+    # the paged engine: its pool allocation is a legitimate startup
+    # compile, not a serving stall.
+    dense.release_steady()
+    del dense
+
+    # -- paged at the same byte budget ---------------------------------
+    probe = PagePool.create(cfg, 1, page_size)
+    bytes_per_page = probe.nbytes // 2   # 1 allocatable + 1 trash page
+    # -1: the pool allocates num_pages + 1 (trash page); counting it
+    # keeps paged_kv_bytes <= dense_kv_bytes, so the concurrency ratio
+    # can never be bought with a bigger cache.
+    num_pages = dense_kv_bytes // bytes_per_page - 1
+    paged = PagedInferenceEngine(
+        cfg, params, max_slots=paged_slots, max_seq_len=max_seq,
+        page_size=page_size, num_pages=int(num_pages),
+        max_queue=n_requests)
+    paged_kv_bytes = paged.cache.nbytes
+    paged.warmup()
+    paged.register_prefix(shared)  # seeds the radix tree
+    unexpected_before = obs_device.SENTINEL.unexpected
+    _, paged_peak, paged_wall, paged_toks = run_workload(paged)
+    unexpected = obs_device.SENTINEL.unexpected - unexpected_before
+    occ = paged.kv_occupancy()
+
+    ratio = paged_peak / max(dense_peak, 1)
+    print(json.dumps({
+        "metric": f"{model} paged KV concurrency vs dense at equal KV "
+                  f"HBM ({n_requests} reqs, prompt {prompt_len}, "
+                  f"prefix {prefix_len}, page_size {page_size})",
+        "value": round(ratio, 2),
+        "unit": "x",
+        # Acceptance is >= 2x concurrent sequences at equal KV HBM
+        # (docs/paged-kv.md), so > 1.0 here means the claim holds.
+        "vs_baseline": round(ratio / 2.0, 4),
+        "dense_peak_concurrent": dense_peak,
+        "paged_peak_concurrent": paged_peak,
+        "dense_kv_bytes": dense_kv_bytes,
+        "paged_kv_bytes": paged_kv_bytes,
+        "num_pages": int(num_pages),
+        "dense_decode_tokens_per_sec": round(dense_toks / dense_wall, 1),
+        "paged_decode_tokens_per_sec": round(paged_toks / paged_wall, 1),
+        "prefix_pages_reused_total": occ["pages_reused_total"],
+        "pages_shared": occ["pages_shared"],
+        "pages_evicted_total": occ["pages_evicted_total"],
+        "unexpected_compiles_steady_loop": unexpected,
+        "platform": jax.default_backend(),
+        "device": str(device),
+    }))
 
 
 def inner() -> None:
@@ -158,9 +292,12 @@ def inner() -> None:
 
 
 if __name__ == "__main__":
+    paged_axis = os.environ.get("RBT_BENCH_PAGED") == "1"
     if "--inner" in sys.argv:
-        inner()
+        paged_inner() if paged_axis else inner()
     else:
         import benchkit
-        benchkit.run_outer(os.path.abspath(__file__),
-                           "serve TTFT p50", "ms")
+        benchkit.run_outer(
+            os.path.abspath(__file__),
+            *(("paged KV concurrency vs dense", "x") if paged_axis
+              else ("serve TTFT p50", "ms")))

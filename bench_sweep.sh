@@ -126,6 +126,14 @@ run serve-quant-none env RBT_BENCH_QUANTIZE=none python bench_serve.py
 run serve-quant-int8 env RBT_BENCH_QUANTIZE=int8 python bench_serve.py
 run serve-quant-int4 env RBT_BENCH_QUANTIZE=int4 python bench_serve.py
 
+# 4a2. Paged KV capacity (docs/paged-kv.md): the same shared-system-
+#      prompt workload against the dense slot pool and the paged engine
+#      sized to the SAME KV HBM bytes — value is the peak-concurrency
+#      ratio (acceptance >= 2x, so vs_baseline = ratio/2 > 1), with
+#      dense/paged decode tok/s, radix-sharing counters, and the
+#      zero-unexpected-compiles steady-loop gate in the same JSON line.
+run serve-paged env RBT_BENCH_PAGED=1 python bench_serve.py
+
 # 4b. Observability instrumentation overhead (docs/observability.md):
 #     the per-step cost of the obs subsystem (spans + histogram observes +
 #     goodput update) as a percent of the real step time, PLUS the fleet-

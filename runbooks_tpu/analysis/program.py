@@ -274,6 +274,49 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
 
     rest = prefill_args(rep_rows, rep_bucket, plen=rep_plen)
 
+    # Paged engine (serve/paging.py): same audit discipline — the paged
+    # factories are the bodies the paged engine jits, traced at their
+    # most complex reachable shape (largest prefix-page bucket splice;
+    # one decode view). Census cardinality comes from the same
+    # enumeration helpers warmup walks.
+    from runbooks_tpu.serve.paging import (
+        PagePool,
+        make_paged_decode_fn,
+        make_paged_prefill_fn,
+        paged_prefill_shapes,
+        view_page_buckets_for,
+    )
+
+    page_size = 16
+    mpps = max_seq_len // page_size
+    pool_pages = slots * mpps
+    paged_pool = jax.eval_shape(lambda: PagePool.create(
+        cfg, pool_pages, page_size, quantize_kv=False))
+    pshapes = paged_prefill_shapes(buckets, mpps, page_size, max_seq_len)
+    vp_buckets = view_page_buckets_for(max_seq_len, page_size)
+    # Widest gather first: the splice cost scales with the prefix-page
+    # bucket (ppb*page_size gathered rows), so audit at max ppb and the
+    # largest suffix bucket reachable alongside it.
+    rep_ppb, rep_b = max((p, b) for b, p in pshapes if p)
+    paged_prefill = make_paged_prefill_fn(cfg, cache_len, page_size,
+                                          pool_pages)
+    paged_prefill_args = [
+        params, paged_pool,
+        _sds((slots, rep_b), jnp.int32), _sds((slots, rep_b), jnp.int32),
+        _sds((slots, mpps), jnp.int32), _sds((slots,), jnp.int32),
+        key, _sds((slots,), jnp.float32), _sds((slots,), jnp.int32),
+        _sds((slots,), jnp.float32),
+        _sds((slots, rep_ppb), jnp.int32), _sds((slots,), jnp.int32)]
+    paged_decode = make_paged_decode_fn(
+        cfg, settings.decode_chunk, max_seq_len, page_size,
+        vp_buckets[-1], pool_pages)
+    paged_decode_args = [
+        params, paged_pool, _sds((slots, mpps), jnp.int32),
+        _sds((slots,), jnp.int32), _sds((slots,), jnp.int32), key,
+        _sds((slots,), jnp.float32), _sds((slots,), jnp.int32),
+        _sds((slots,), jnp.float32), _sds((slots,), jnp.int32),
+        _sds((slots,), jnp.int32), _sds((slots,), jnp.bool_)]
+
     return [
         {"component": "serve", "name": "prefill", "fn": prefill,
          "args": prefill_args(rows_set[-1], buckets[-1]),
@@ -288,6 +331,12 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
          "args": [params, _sds((1, buckets[-1]), jnp.int32),
                   _sds((1, buckets[-1]), jnp.int32)],
          "signatures": len(buckets)},
+        {"component": "serve", "name": "paged_prefill",
+         "fn": paged_prefill, "args": paged_prefill_args,
+         "signatures": len(pshapes) * len(rows_set)},
+        {"component": "serve", "name": "paged_decode",
+         "fn": paged_decode, "args": paged_decode_args,
+         "signatures": len(vp_buckets)},
     ]
 
 

@@ -671,13 +671,24 @@ def _top_hbm(families, sel: dict) -> str:
 
 
 def _top_slots(families, sel: dict) -> str:
-    """Slot-utilization cell: active/total slots + KV token occupancy
-    (the paged-KV headroom signal; docs/observability.md)."""
+    """Slot-utilization cell: active/total slots + KV occupancy. A paged
+    engine (serve_kv_pages_* series present) renders page occupancy and
+    the radix-shared share of the pool (`kv=N% shared=M%`,
+    docs/paged-kv.md); dense engines keep the token-occupancy ratio."""
     active = _metric_value(families, "serve_active_slots", sel)
     total = _metric_value(families, "serve_slots_total", sel)
     if active is None or not total:
         return "-"
     cell = f"{active:.0f}/{total:.0f}"
+    used = _metric_value(families, "serve_kv_pages_used", sel)
+    free = _metric_value(families, "serve_kv_pages_free", sel)
+    if used is not None and free is not None and used + free > 0:
+        pool = used + free
+        shared = _metric_value(families, "serve_kv_pages_shared",
+                               sel) or 0
+        cell += (f" kv={used / pool * 100:.0f}%"
+                 f" shared={shared / pool * 100:.0f}%")
+        return cell
     kv = _metric_value(families, "serve_kv_occupancy_ratio", sel)
     if kv is not None:
         cell += f" kv={kv * 100:.0f}%"

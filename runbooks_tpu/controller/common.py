@@ -77,6 +77,10 @@ _CM_ENUM = ("off", "ring", "auto")
 ENUM_PARAMS = {
     "quantize": ("none", "int8", "int4"),
     "source": ("huggingface", "dir", "random"),
+    # Paged KV serving (serve/paging.py, docs/paged-kv.md): a typo'd
+    # value would otherwise silently serve the dense slot pool.
+    **{k: ("off", "paged") for k in ("kv_paging", "kvPaging",
+                                     "kvpaging")},
     **{k: _ACCUM_ENUM for k in _ACCUM_KEYS},
     **{k: _CM_ENUM for k in _CM_KEYS},
 }
@@ -106,6 +110,13 @@ INT_PARAMS = {
     # Serving admission-queue bound (serve/api.py); 0 = reject everything
     # (load-shed), still valid.
     "max_queue": 0,
+    # Paged KV pool geometry (serve/paging.py): page_size must divide
+    # max_seq_len — checked at engine construction; here we catch the
+    # crash-loop-shaped typos (non-integers, absurd values).
+    "page_size": 8,
+    "num_pages": 1,
+    **{k: 1 for k in ("numPages", "numpages")},
+    **{k: 8 for k in ("pageSize", "pagesize")},
     # Consecutive non-finite steps the trainer tolerates before aborting.
     **{k: 1 for k in _MAX_BAD_STEPS_KEYS},
     **{k: 0 for k in _RESTART_KEYS},

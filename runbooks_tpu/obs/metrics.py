@@ -107,6 +107,12 @@ CATALOG: Dict[str, str] = {
     "serve_kv_occupancy_ratio": "gauge",
     "serve_prefix_lookups_total": "counter",
     "serve_prefix_hits_total": "counter",
+    # Paged KV pool (serve/paging.py, docs/paged-kv.md): exported only
+    # when the engine runs paged
+    "serve_kv_pages_free": "gauge",
+    "serve_kv_pages_used": "gauge",
+    "serve_kv_pages_shared": "gauge",
+    "serve_prefix_pages_reused_total": "counter",
     # process
     "process_uptime_seconds": "gauge",
 }

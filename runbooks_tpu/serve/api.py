@@ -30,6 +30,7 @@ from runbooks_tpu.models.config import ModelConfig, get_config
 from runbooks_tpu.serve.engine import (
     EngineDraining,
     EngineOverloaded,
+    EngineStepFailed,
     InferenceEngine,
     Request,
 )
@@ -273,7 +274,7 @@ class EngineWorker:
                             continue
                         self._inflight.append((req, fut))
                     self._pending.clear()
-                for tokens, fut in prefix_jobs:
+                for job_i, (tokens, fut) in enumerate(prefix_jobs):
                     try:
                         # Register WITHOUT the inline warmup sweep (each
                         # shape is an XLA compile — ~27 s cold on the v5e
@@ -281,7 +282,13 @@ class EngineWorker:
                         # in-flight stream). Shapes queue and warm one per
                         # loop iteration, interleaved with decode steps.
                         fresh = not self.engine.has_prefix(tokens)
-                        if fresh and self._warn_cold_prefix:
+                        # Paged engines compile nothing at registration
+                        # (prefix_warmup_shapes() is empty: warmup already
+                        # covered every reachable shape) — the stall
+                        # warning would be a false alarm there.
+                        if fresh and self._warn_cold_prefix \
+                                and self.engine.prefix_warmup_shapes(
+                                    len(tokens)):
                             self._warn_cold_prefix = False
                             print(
                                 "serve: runtime /v1/prefix registration "
@@ -299,6 +306,19 @@ class EngineWorker:
                     except Exception as exc:  # noqa: BLE001
                         if not fut.done():
                             fut.set_exception(exc)
+                        if isinstance(exc, EngineStepFailed):
+                            # The paged register_prefix drives jitted
+                            # steps that donate the cache: a failure
+                            # there poisons the engine like a crash in
+                            # the main step loop would. Fail the jobs
+                            # not yet reached (the crash handler below
+                            # only sees _prefix_jobs still on the
+                            # instance) and route to it for the full
+                            # doom + reset.
+                            for _t, f in prefix_jobs[job_i + 1:]:
+                                if not f.done():
+                                    f.set_exception(exc)
+                            raise
                 if not self.engine.has_work():
                     if self._prefix_warm_queue:
                         self._warm_one()
@@ -436,24 +456,44 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   prefix_cache_size: Optional[int] = None,
                   max_queue: Optional[int] = None,
                   request_timeout_s: Optional[float] = None,
-                  drain_timeout_s: float = 30.0) -> web.Application:
+                  drain_timeout_s: float = 30.0,
+                  kv_paging: bool = False,
+                  page_size: int = 16,
+                  num_pages: Optional[int] = None) -> web.Application:
     """max_queue bounds the admission queue (full -> HTTP 429 with
     Retry-After); request_timeout_s is the default per-request wall-clock
     deadline (body field "timeout" overrides per request; expiry finishes
     the request with finish_reason "deadline"; 0/None = no default
     deadline); drain_timeout_s bounds the SIGTERM graceful drain
-    (docs/fault-tolerance.md)."""
+    (docs/fault-tolerance.md).
+
+    kv_paging=True serves from the paged KV engine (serve/paging.py):
+    the cache becomes num_pages pages of page_size tokens with radix-tree
+    prefix sharing across requests, and admission gates on free pages
+    instead of dense slot rows — docs/paged-kv.md covers sizing
+    page_size/num_pages (default num_pages matches the dense worst-case
+    reservation)."""
     if not request_timeout_s:
         # 0 disables, like the other *_s knobs — a validated config of 0
         # must mean "no deadline", not "400 every deadline-less request".
         request_timeout_s = None
     tokenizer = tokenizer or load_tokenizer(None)
-    engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
-                             max_seq_len=max_seq_len, mesh=mesh,
-                             prefill_budget=prefill_budget,
-                             decode_chunk=decode_chunk,
-                             prefix_cache_size=prefix_cache_size,
-                             max_queue=max_queue)
+    if kv_paging:
+        from runbooks_tpu.serve.paging import PagedInferenceEngine
+
+        engine = PagedInferenceEngine(
+            cfg, model_params, max_slots=max_slots,
+            max_seq_len=max_seq_len, mesh=mesh,
+            prefill_budget=prefill_budget, decode_chunk=decode_chunk,
+            prefix_cache_size=prefix_cache_size, max_queue=max_queue,
+            page_size=page_size, num_pages=num_pages)
+    else:
+        engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
+                                 max_seq_len=max_seq_len, mesh=mesh,
+                                 prefill_budget=prefill_budget,
+                                 decode_chunk=decode_chunk,
+                                 prefix_cache_size=prefix_cache_size,
+                                 max_queue=max_queue)
     if warmup:
         # Pre-compile all buckets before readiness flips. warm_prefix
         # (params.json: warm_prefix) additionally compiles the prefix-KV
@@ -559,6 +599,25 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         reg.set_counter("serve_prefix_hits_total", eng.prefix_hits,
                         help_text="Admissions whose prompt matched a "
                                   "registered prefix.")
+        if occ.get("paged"):
+            # Paged engine (serve/paging.py): page-pool pressure + radix
+            # sharing, the per-PAGE extension of the admission-level hit
+            # counters above (docs/paged-kv.md).
+            reg.set_gauge("serve_kv_pages_free", occ["pages_free"],
+                          help_text="Allocatable KV pages currently on "
+                                    "the free list.")
+            reg.set_gauge("serve_kv_pages_used", occ["pages_used"],
+                          help_text="KV pages held by live slots or the "
+                                    "radix prefix tree.")
+            reg.set_gauge("serve_kv_pages_shared", occ["pages_shared"],
+                          help_text="KV pages owned by the radix prefix "
+                                    "tree (shareable across requests).")
+            reg.set_counter("serve_prefix_pages_reused_total",
+                            occ["pages_reused_total"],
+                            help_text="Physical KV pages mapped from the "
+                                      "radix tree into admissions instead "
+                                      "of being re-prefilled (counted per "
+                                      "page, not per admission).")
         obs_device.set_memory_gauges(reg)
         obs_device.PROGRAMS.set_gauges(reg, component="serve")
         body = reg.render().encode("utf-8")
@@ -1074,6 +1133,15 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     return app
 
 
+def _param_any(params: dict, *keys: str, default=None):
+    """First present spelling of a params key (snake_case params.json,
+    the reference's camelCase spec style, the PARAM_* env lowercase)."""
+    for k in keys:
+        if params.get(k) is not None:
+            return params[k]
+    return default
+
+
 def main() -> int:
     params = contract.load_params()
     # Multi-host slices: form the jax.distributed runtime before any JAX use.
@@ -1100,6 +1168,7 @@ def main() -> int:
     if mesh_args:
         mesh = make_mesh(MeshConfig(**mesh_args))
 
+    num_pages_raw = _param_any(params, "num_pages", "numPages", "numpages")
     app = create_server(
         cfg, model_params, tokenizer,
         max_slots=int(params.get("max_slots", 8)),
@@ -1119,7 +1188,18 @@ def main() -> int:
         request_timeout_s=(float(params["request_timeout_s"])
                            if params.get("request_timeout_s") is not None
                            else None),
-        drain_timeout_s=float(params.get("drain_timeout_s", 30.0)))
+        drain_timeout_s=float(params.get("drain_timeout_s", 30.0)),
+        # Paged KV serving (docs/paged-kv.md): `kv_paging: paged` is the
+        # validated spelling (controller validate_params, every case the
+        # PARAM_* env round-trip produces); bools are accepted for
+        # hand-written params.json.
+        kv_paging=str(_param_any(params, "kv_paging", "kvPaging",
+                                 "kvpaging", default="off")).lower()
+        in ("paged", "on", "true", "1"),
+        page_size=int(_param_any(params, "page_size", "pageSize",
+                                 "pagesize", default=16)),
+        num_pages=(int(num_pages_raw)
+                   if num_pages_raw is not None else None))
     port = int(params.get("port", contract.SERVE_PORT))
 
     # Graceful drain on SIGTERM (docs/fault-tolerance.md): run_app's

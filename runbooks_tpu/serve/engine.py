@@ -84,6 +84,15 @@ class EngineDraining(EngineOverloaded):
     requests finish before exit. Maps to HTTP 503."""
 
 
+class EngineStepFailed(RuntimeError):
+    """A jitted engine step raised: the donated KV cache buffers may be
+    invalid and slot/page bookkeeping half-applied, so the engine needs a
+    full reset() before it can serve again. Raised by paths that drive
+    step() on behalf of a single caller (paged register_prefix) so the
+    worker routes them to its crash handler instead of swallowing them
+    per-job (serve/api.py)."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request (engine-internal)."""
@@ -407,8 +416,8 @@ class InferenceEngine:
         self.params = params
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
-        self.cache = self._new_pool_cache()
         self._pad_slot = self.max_seq_len  # trash slot index
+        self._init_cache()
         if self.prefill_budget is None:
             self.prefill_budget = self.max_seq_len
         self.max_queue = (max_queue if max_queue is not None
@@ -454,7 +463,19 @@ class InferenceEngine:
         obs_device.SENTINEL.install()
         self.warmup_census: Optional[dict] = None
         self._marked_steady = False  # one steady claim per engine
+        self._init_programs()
 
+    def _init_cache(self) -> None:
+        """Allocate the engine's KV storage. Overridable: the paged
+        engine (serve/paging.py) replaces the dense slot pool with a
+        fixed page pool + allocator + radix tree here."""
+        self.cache = self._new_pool_cache()
+
+    def _init_programs(self) -> None:
+        """Build and register the engine's jitted program set. Overridable
+        for the same reason as _init_cache (the paged engine jits
+        gather-by-page-index variants of prefill/decode instead)."""
+        cfg = self.cfg
         cache_len = self.max_seq_len + 1
 
         prefill_fn = make_prefill_fn(cfg, cache_len)
@@ -1052,6 +1073,15 @@ class InferenceEngine:
             self.active[slot] = False
             self.slot_req[slot] = None
             _observe_request_done(req, now)
+            self._on_slot_finished(slot, req)
+
+    def _on_slot_finished(self, slot: int, req: Request) -> None:
+        """Called once per slot whose request just finished (normal stop,
+        length, or deadline expiry), after the slot's bookkeeping is
+        cleared but before the slot can be re-admitted. No-op for the
+        dense pool (the slot's cache rows simply get overwritten); the
+        paged engine releases the slot's page references here and adopts
+        its completed pages into the radix tree (serve/paging.py)."""
 
     def _expire_deadlines(self) -> List[int]:
         """Finish requests whose wall-clock deadline passed (between decode
@@ -1089,10 +1119,59 @@ class InferenceEngine:
                 _observe_request_done(req, now)
                 self.active[slot] = False
                 self.slot_req[slot] = None
+                self._on_slot_finished(slot, req)
                 freed.append(slot)
                 n += 1
         self.deadline_expired += n
         return freed
+
+    def _sampling_operands(self):
+        """Per-slot sampling + device-side finish-tracking operands for
+        one decode chunk (inactive rows get inert values; eos/remaining
+        mirror _record_token: EOS id (-1 = none), tokens left in the
+        request budget). Shared with the paged engine's step
+        (serve/paging.py)."""
+        temps = np.array([self.slot_req[i].temperature if self.active[i]
+                          else 0.0 for i in range(self.max_slots)], np.float32)
+        top_ks = np.array([self.slot_req[i].top_k if self.active[i] else 0
+                           for i in range(self.max_slots)], np.int32)
+        top_ps = np.array([self.slot_req[i].top_p if self.active[i] else 1.0
+                           for i in range(self.max_slots)], np.float32)
+        eos_ids = np.array([
+            self.slot_req[i].eos_id
+            if self.active[i] and self.slot_req[i].eos_id is not None else -1
+            for i in range(self.max_slots)], np.int32)
+        remaining = np.array([
+            self.slot_req[i].max_tokens - len(self.slot_req[i].output_tokens)
+            if self.active[i] else 0
+            for i in range(self.max_slots)], np.int32)
+        return temps, top_ks, top_ps, eos_ids, remaining
+
+    def _decode_span_attrs(self) -> dict:
+        """Decode-span attrs, computed only when tracing is on: span()
+        itself is a no-op when off, but eager kwargs would still charge
+        the decode hot loop an array reduction per chunk."""
+        if not trace_enabled():
+            return {}
+        return {"active": int(self.active.sum()),
+                "request_ids": [self.slot_req[i].request_id
+                                for i in range(self.max_slots)
+                                if self.active[i]]}
+
+    def _replay_chunk(self, toks, valid) -> int:
+        """Replay one decode chunk on the host: `valid[k]` is exactly the
+        set of slots that were alive at device step k, so this loop lands
+        in the same bookkeeping state as chunk=1 stepping would. Returns
+        tokens generated."""
+        generated = 0
+        for k in range(toks.shape[0]):
+            for slot in np.nonzero(valid[k])[0]:
+                generated += 1
+                self.lengths[slot] += 1
+                tok = int(toks[k, slot])
+                self.last_token[slot] = tok
+                self._record_token(slot, tok)
+        return generated
 
     def step(self) -> int:
         """Admit queued requests, run one decode chunk (`decode_chunk`
@@ -1106,34 +1185,12 @@ class InferenceEngine:
         # mid-chunk, rows that finish are parked there by the device mask.
         positions = np.where(self.active, self.lengths,
                              self._pad_slot).astype(np.int32)
-        temps = np.array([self.slot_req[i].temperature if self.active[i]
-                          else 0.0 for i in range(self.max_slots)], np.float32)
-        top_ks = np.array([self.slot_req[i].top_k if self.active[i] else 0
-                           for i in range(self.max_slots)], np.int32)
-        top_ps = np.array([self.slot_req[i].top_p if self.active[i] else 1.0
-                           for i in range(self.max_slots)], np.float32)
-        # Device-side finish tracking mirrors _record_token: EOS id (-1 =
-        # none), tokens left in the request budget, room left in the cache.
-        eos_ids = np.array([
-            self.slot_req[i].eos_id
-            if self.active[i] and self.slot_req[i].eos_id is not None else -1
-            for i in range(self.max_slots)], np.int32)
-        remaining = np.array([
-            self.slot_req[i].max_tokens - len(self.slot_req[i].output_tokens)
-            if self.active[i] else 0
-            for i in range(self.max_slots)], np.int32)
+        temps, top_ks, top_ps, eos_ids, remaining = self._sampling_operands()
         view = self._view_for(int(self.lengths[self.active].max())
                               + self.decode_chunk)
         t_dispatch = time.perf_counter()
-        # The active-count span attr is computed only when tracing is on:
-        # span() itself is a no-op when off, but eager kwargs would still
-        # charge the decode hot loop an array reduction per chunk.
-        attrs = ({"active": int(self.active.sum()),
-                  "request_ids": [self.slot_req[i].request_id
-                                  for i in range(self.max_slots)
-                                  if self.active[i]]}
-                 if trace_enabled() else {})
-        with span("decode", view=view, **attrs), self._mesh_ctx():
+        with span("decode", view=view, **self._decode_span_attrs()), \
+                self._mesh_ctx():
             toks, valid, self.cache, self.rng = self._decode_for(view)(
                 self.params, self.cache, jnp.asarray(self.last_token),
                 jnp.asarray(positions), self.rng,
@@ -1149,17 +1206,7 @@ class InferenceEngine:
             time.perf_counter() - t_dispatch, view=str(view),
             help_text="Decode-chunk dispatch+sync wall time, labeled by "
                       "cache view bucket.")
-        # Replay the chunk on the host: `valid[k]` is exactly the set of
-        # slots that were alive at device step k, so this loop lands in the
-        # same bookkeeping state as chunk=1 stepping would.
-        generated = 0
-        for k in range(toks.shape[0]):
-            for slot in np.nonzero(valid[k])[0]:
-                generated += 1
-                self.lengths[slot] += 1
-                tok = int(toks[k, slot])
-                self.last_token[slot] = tok
-                self._record_token(slot, tok)
+        generated = self._replay_chunk(toks, valid)
         self.steps += 1
         return generated
 

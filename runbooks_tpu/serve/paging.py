@@ -1,0 +1,1148 @@
+"""Paged KV cache with radix-tree prefix sharing for the serve engine.
+
+The dense engine (serve/engine.py) reserves a whole ``[max_seq_len+1]``
+cache row per slot, so concurrency is fixed by worst-case sequence length
+and a shared system prompt is stored once per slot. This module breaks the
+cache into fixed-size pages and shares physical pages between requests:
+
+- **Page pool** (``PagePool`` + ``PageAllocator``): K/V live in
+  ``[layers, num_pages+1, page_size, kv_heads, head_dim]`` arrays (the
+  last page is the trash page — the scatter target for padding, never
+  allocated). A host-side free list hands out pages; refcounts track how
+  many owners (slots, the radix tree) hold each page.
+- **Radix tree** (``RadixTree``): a host-side trie over token ids at page
+  granularity — each edge is exactly ``page_size`` tokens and each node
+  owns the physical page holding that span's K/V. Admission matches the
+  longest registered prefix and maps the slot's leading page-table
+  entries to the *same* physical pages; finished requests adopt their
+  fully-written pages into the tree, so every served prompt seeds reuse
+  for the next one (the many-user generalization of the dense engine's
+  single-prefix ``auto_prefix``). Unreferenced prefix pages evict LRU
+  under page pressure.
+- **Copy-on-write by construction**: shared pages hold only *complete*
+  pages of prompt prefix, and decode writes land at positions at or past
+  the prompt length — always in the slot's private pages. Two requests
+  sharing a prefix therefore diverge mid-generation without ever copying
+  a page or corrupting each other (tests/test_paging.py proves it). The
+  partial page at a prefix boundary is never shared; its tokens prefill
+  into the slot's first private page.
+- **Static shapes**: the compiled programs see a fixed page count, a
+  fixed ``[rows, max_pages_per_slot]`` int32 page-table operand, and
+  bucketed prefix-page counts (powers of two), so the program census
+  stays small and the compile sentinel stays quiet after warmup
+  (``paged_prefill_shapes`` enumerates the full set — warmup, ``rbt
+  check`` and the baseline all walk it).
+
+Attention runs over a **gathered view**: decode flattens the pool to
+``[layers, (num_pages+1)*page_size, ...]``, gathers each slot's pages
+into a contiguous ``[slots, view, ...]`` view by flat token index, runs
+the existing ``forward`` on it, and scatters each newly written token
+back to its page. The gather streams the same bytes the dense view slice
+would; the cost is one extra materialized copy per chunk (a fused paged
+attention kernel can fold it away later — docs/paged-kv.md discusses the
+tradeoff). int8 KV quantization composes: pages store int8 plus the same
+per-token-per-head scales, spliced by the same quantize path.
+
+Sizing guidance and the page-size tradeoff live in docs/paged-kv.md;
+``serve_kv_pages_{free,used,shared}`` gauges (docs/observability.md)
+report the pool live.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from runbooks_tpu.models.config import ModelConfig
+from runbooks_tpu.models.transformer import KVCache, forward
+from runbooks_tpu.obs import device as obs_device
+from runbooks_tpu.obs import metrics as obs_metrics
+from runbooks_tpu.obs.trace import complete as trace_complete
+from runbooks_tpu.obs.trace import span, trace_enabled
+from runbooks_tpu.ops.sampling import sample
+from runbooks_tpu.serve.engine import (
+    EngineStepFailed,
+    InferenceEngine,
+    Request,
+    view_buckets_for,
+)
+
+Params = Any
+
+
+# ---------------------------------------------------------------------------
+# Page pool
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagePool:
+    """Device-side paged KV storage.
+
+    k, v: [num_layers, num_pages + 1, page_size, num_kv_heads, head_dim]
+    — page ``num_pages`` is the TRASH page: the scatter destination for
+    padding rows and parked decode slots, never handed out by the
+    allocator. With quantize_kv, k/v are int8 and k_scale/v_scale carry
+    one f32 scale per (layer, page, slot-in-page, kv-head) — the same
+    per-token-per-head granularity as the dense int8 pool, so the
+    splice-quantize/dequantize-at-read path is unchanged.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @classmethod
+    def create(cls, cfg: ModelConfig, num_pages: int, page_size: int,
+               quantize_kv: bool = False) -> "PagePool":
+        shape = (cfg.num_layers, num_pages + 1, page_size,
+                 cfg.num_kv_heads, cfg.head_dim)
+        if quantize_kv:
+            return cls(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       k_scale=jnp.zeros(shape[:-1], jnp.float32),
+                       v_scale=jnp.zeros(shape[:-1], jnp.float32))
+        return cls(k=jnp.zeros(shape, cfg.activation_dtype),
+                   v=jnp.zeros(shape, cfg.activation_dtype))
+
+    @property
+    def quantized(self) -> bool:
+        return self.k.dtype == jnp.int8
+
+    @property
+    def nbytes(self) -> int:
+        return sum(x.nbytes for x in (self.k, self.v, self.k_scale,
+                                      self.v_scale) if x is not None)
+
+
+class PageAllocator:
+    """Host-side free-list allocator with refcounts over a fixed page set.
+
+    Page ids 0..num_pages-1 are allocatable. A freshly alloc'd page has
+    refcount 1 (the caller's); incref/decref add and drop owners, and a
+    page returns to the free list exactly when its count hits zero. All
+    methods run on the engine worker thread (the engine is
+    single-threaded by design); the counts read by /metrics are plain
+    ints, safe to read racily.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = num_pages
+        # pop() hands out ascending ids — deterministic tests.
+        self._free = list(range(num_pages - 1, -1, -1))
+        self._ref = np.zeros(num_pages, np.int64)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return int(self._ref[page])
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh pages (refcount 1 each), or None — all-or-nothing, so
+        a half-admitted request can never hold pages it cannot use."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
+
+    def incref(self, pages) -> None:
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise RuntimeError(f"incref of free page {p}")
+            self._ref[p] += 1
+
+    def decref(self, pages) -> List[int]:
+        """Drop one reference per page; returns the pages actually freed
+        (count hit zero)."""
+        freed = []
+        for p in pages:
+            p = int(p)
+            if self._ref[p] <= 0:
+                raise RuntimeError(f"decref of free page {p}")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+
+# ---------------------------------------------------------------------------
+# Radix tree over token prefixes (page granularity)
+# ---------------------------------------------------------------------------
+
+class _RadixNode:
+    __slots__ = ("children", "page", "parent", "edge", "last_used")
+
+    def __init__(self, parent=None, edge=None, page: int = -1):
+        self.children: Dict[tuple, "_RadixNode"] = {}
+        self.page = page
+        self.parent = parent
+        self.edge = edge
+        self.last_used = 0
+
+
+class RadixTree:
+    """Trie over token-id sequences at page granularity.
+
+    Each edge is a tuple of exactly ``page_size`` token ids; the child
+    node owns the physical page holding that span's K/V. The tree itself
+    holds one allocator reference per adopted page (so a page shared by
+    the tree and two slots has refcount 3); ``evict`` drops LRU leaves
+    whose pages nobody but the tree references. Only *complete* pages
+    are ever inserted — a prefix ending mid-page shares its full pages
+    and recomputes the partial tail (copy-on-write by construction; see
+    the module docstring).
+    """
+
+    def __init__(self, page_size: int, allocator: PageAllocator):
+        self.page_size = page_size
+        self.allocator = allocator
+        self.root = _RadixNode()
+        self.nodes = 0            # pages currently owned by the tree
+        self.pages_evicted = 0    # cumulative (observability)
+        self._clock = 0           # logical LRU clock (match/insert ticks)
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, tokens) -> List[int]:
+        """Physical pages for the longest full-page prefix of ``tokens``
+        present in the tree (possibly empty). Refreshes LRU recency on
+        the matched path. Does NOT take references — the caller increfs
+        when it commits to using the pages."""
+        ps = self.page_size
+        node = self.root
+        pages: List[int] = []
+        now = self._tick()
+        for i in range(len(tokens) // ps):
+            child = node.children.get(tuple(tokens[i * ps:(i + 1) * ps]))
+            if child is None:
+                break
+            child.last_used = now
+            pages.append(child.page)
+            node = child
+        return pages
+
+    def insert(self, tokens, pages) -> int:
+        """Adopt ``pages[i]`` as the shared page for the i-th full page
+        of ``tokens``, for every position not already in the tree (the
+        tree increfs adopted pages; an existing node keeps its page and
+        the caller's duplicate stays private — it frees with the slot).
+        Returns the number of pages adopted."""
+        ps = self.page_size
+        node = self.root
+        adopted = 0
+        now = self._tick()
+        for i in range(min(len(tokens) // ps, len(pages))):
+            edge = tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+            child = node.children.get(edge)
+            if child is None:
+                child = _RadixNode(parent=node, edge=edge,
+                                   page=int(pages[i]))
+                node.children[edge] = child
+                self.allocator.incref([child.page])
+                self.nodes += 1
+                adopted += 1
+            child.last_used = now
+            node = child
+        return adopted
+
+    def _leaves(self) -> List[_RadixNode]:
+        out, stack = [], [self.root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                (stack if c.children else out).append(c)
+        return out
+
+    def evict(self, want: int) -> int:
+        """Free up to ``want`` pages by dropping least-recently-used
+        leaves whose pages no live slot references (allocator refcount
+        == 1, i.e. tree-only). Dropping a leaf can expose its parent as
+        the next candidate; the parent is pushed into the same LRU heap
+        instead of re-walking the tree per round, so eviction on the
+        admission path is O((leaves + freed) log n) even for deep cold
+        chains. Returns the number of pages freed."""
+        freed = 0
+        heap = [(n.last_used, id(n), n) for n in self._leaves()
+                if self.allocator.refcount(n.page) == 1]
+        heapq.heapify(heap)
+        while heap and freed < want:
+            _, _, v = heapq.heappop(heap)
+            del v.parent.children[v.edge]
+            self.allocator.decref([v.page])
+            self.nodes -= 1
+            freed += 1
+            p = v.parent
+            # Refcounts can't move under us (eviction runs on the single
+            # serving thread), so a pinned parent is skipped for good —
+            # exactly the pin-before-evict contract _admit relies on.
+            if (p is not self.root and not p.children
+                    and self.allocator.refcount(p.page) == 1):
+                heapq.heappush(heap, (p.last_used, id(p), p))
+        self.pages_evicted += freed
+        return freed
+
+
+# ---------------------------------------------------------------------------
+# Bucketing helpers (shared by the engine, warmup, and `rbt check`)
+# ---------------------------------------------------------------------------
+
+def prefix_page_buckets(max_pages_per_slot: int) -> List[int]:
+    """The static prefix-page-count buckets the splice programs compile
+    at: powers of two up to (and always including) max_pages_per_slot.
+    A bounded set keeps the program census a budget — an arbitrary
+    per-prompt shared-page count would mint a fresh XLA program per
+    distinct prefix length (the dense engine's auto_prefix quantization,
+    one level up)."""
+    out, b = [], 1
+    while b < max_pages_per_slot:
+        out.append(b)
+        b *= 2
+    out.append(max_pages_per_slot)
+    return out
+
+
+def page_bucket(n_pages: int, max_pages_per_slot: int) -> int:
+    """Smallest prefix-page bucket covering n_pages (0 stays 0)."""
+    if n_pages <= 0:
+        return 0
+    for b in prefix_page_buckets(max_pages_per_slot):
+        if n_pages <= b:
+            return b
+    return max_pages_per_slot
+
+
+def view_page_buckets_for(max_seq_len: int, page_size: int) -> List[int]:
+    """Decode view buckets in PAGES: the dense engine's token views
+    (view_buckets_for) rounded up to whole pages."""
+    return sorted({-(-v // page_size)
+                   for v in view_buckets_for(max_seq_len)})
+
+
+def paged_prefill_shapes(prefill_buckets: List[int],
+                         max_pages_per_slot: int, page_size: int,
+                         max_seq_len: int) -> List[Tuple[int, int]]:
+    """Every reachable (suffix bucket, prefix-page bucket) combination —
+    the paged prefill program census. A combination is reachable when
+    some prompt can land in it: the smallest shared-page count mapping
+    to the bucket leaves room inside the context window for a suffix
+    that maps to the suffix bucket. Warmup compiles exactly this set;
+    `rbt check` audits the same enumeration (program-census-drift)."""
+    ppbs = prefix_page_buckets(max_pages_per_slot)
+    shapes: List[Tuple[int, int]] = []
+    for ppb in [0] + ppbs:
+        if ppb == 0:
+            m_min = 0
+        else:
+            idx = ppbs.index(ppb)
+            m_min = 1 if idx == 0 else ppbs[idx - 1] + 1
+        max_suffix = max_seq_len - m_min * page_size
+        if max_suffix < 1:
+            continue
+        for i, b in enumerate(prefill_buckets):
+            s_min = prefill_buckets[i - 1] + 1 if i else 1
+            if s_min <= max_suffix:
+                shapes.append((b, ppb))
+    return shapes
+
+
+# ---------------------------------------------------------------------------
+# Jitted program bodies (module-level factories — audited by `rbt check`
+# exactly like the dense engine's; runbooks_tpu/analysis/program.py traces
+# these same bodies abstractly).
+# ---------------------------------------------------------------------------
+
+def make_paged_prefill_fn(cfg: ModelConfig, cache_len: int,
+                          page_size: int, num_pages: int):
+    """Batched paged prefill + first-token sample, one dispatch per
+    admission group. Rows prefill into fresh scratch rows (exactly the
+    dense prefill's discipline); a shared prefix is GATHERED from its
+    physical pages into positions [0, prefix_len) of each scratch row
+    first, and afterwards only the SUFFIX tokens scatter back out to the
+    row's private pages — shared pages are never written. The program is
+    keyed on (rows, suffix bucket, prefix-page bucket) shapes; padding
+    rows and pad tokens scatter harmlessly to the trash page."""
+    n_flat = (num_pages + 1) * page_size
+    trash_flat = num_pages * page_size      # token 0 of the trash page
+    scratch_trash = cache_len - 1           # scratch rows' trash slot
+    L, kvh, d = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+
+    def paged_prefill_fn(params, pool, tokens, positions, dest_pages,
+                         last_pos, rng, temps, top_ks, top_ps,
+                         prefix_pages=None, prefix_len=None):
+        rows, _bucket = tokens.shape
+        ad = cfg.activation_dtype
+        quantized = pool.k.dtype == jnp.int8
+        flat_k = pool.k.reshape(L, n_flat, kvh, d)
+        flat_v = pool.v.reshape(L, n_flat, kvh, d)
+        flat_ks = (pool.k_scale.reshape(L, n_flat, kvh)
+                   if quantized else None)
+        flat_vs = (pool.v_scale.reshape(L, n_flat, kvh)
+                   if quantized else None)
+
+        row_shape = (L, rows, cache_len, kvh, d)
+        k1 = jnp.zeros(row_shape, ad)
+        v1 = jnp.zeros(row_shape, ad)
+        if prefix_pages is not None and prefix_pages.shape[1] > 0:
+            # Gather the shared prefix out of its physical pages into
+            # the scratch rows, so the suffix forward attends it exactly
+            # as the dense splice path would. Pages beyond a row's real
+            # prefix_len are trash-padded; their garbage scatters to the
+            # scratch trash slot, which no query ever attends.
+            ppw = prefix_pages.shape[1] * page_size
+            t = jnp.arange(ppw, dtype=jnp.int32)
+            fidx = (prefix_pages[:, t // page_size] * page_size
+                    + t % page_size)                      # [rows, ppw]
+            gk = flat_k[:, fidx]                  # [L, rows, ppw, kvh, d]
+            gv = flat_v[:, fidx]
+            if quantized:
+                from runbooks_tpu.ops.quantization import dequantize_kv
+
+                gk = dequantize_kv(gk, flat_ks[:, fidx], ad)
+                gv = dequantize_kv(gv, flat_vs[:, fidx], ad)
+            else:
+                gk = gk.astype(ad)
+                gv = gv.astype(ad)
+            sp = jnp.where(t[None, :] < prefix_len[:, None],
+                           t[None, :], scratch_trash)     # [rows, ppw]
+            r_idx = jnp.arange(rows, dtype=jnp.int32)[:, None]
+            k1 = k1.at[:, r_idx, sp].set(gk)
+            v1 = v1.at[:, r_idx, sp].set(gv)
+        cache1 = KVCache(k=k1, v=v1, index=jnp.zeros((), jnp.int32))
+        logits, cache1 = forward(cfg, params, tokens,
+                                 positions=positions, cache=cache1)
+
+        # Scatter the suffix K/V to the rows' private pages, by the same
+        # positions operand the forward wrote them at. Pad tokens sit at
+        # the scratch trash position -> routed to the trash page.
+        wpos = jnp.clip(positions, 0, cache_len - 1)
+        idx5 = wpos[None, :, :, None, None]
+        sk = jnp.take_along_axis(cache1.k, idx5, axis=2)
+        sv = jnp.take_along_axis(cache1.v, idx5, axis=2)
+        if quantized:
+            from runbooks_tpu.ops.quantization import quantize_kv
+
+            sk, sks = quantize_kv(sk)
+            sv, svs = quantize_kv(sv)
+        valid = positions < scratch_trash
+        page = jnp.take_along_axis(
+            dest_pages,
+            jnp.clip(wpos // page_size, 0, dest_pages.shape[1] - 1),
+            axis=1)                                       # [rows, bucket]
+        fi = jnp.where(valid, page * page_size + wpos % page_size,
+                       trash_flat)
+        flat_k = flat_k.at[:, fi].set(sk)
+        flat_v = flat_v.at[:, fi].set(sv)
+        if quantized:
+            flat_ks = flat_ks.at[:, fi].set(sks)
+            flat_vs = flat_vs.at[:, fi].set(svs)
+
+        rng, sub = jax.random.split(rng)
+        last_logits = jnp.take_along_axis(
+            logits, last_pos[:, None, None], axis=1)[:, 0]
+        first = sample(last_logits, sub, temps, top_ks, top_ps)
+        new_pool = PagePool(
+            k=flat_k.reshape(pool.k.shape),
+            v=flat_v.reshape(pool.v.shape),
+            k_scale=(flat_ks.reshape(pool.k_scale.shape)
+                     if quantized else None),
+            v_scale=(flat_vs.reshape(pool.v_scale.shape)
+                     if quantized else None))
+        return first, new_pool, rng
+
+    return paged_prefill_fn
+
+
+def make_paged_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
+                         page_size: int, view_pages: int, num_pages: int):
+    """``chunk`` decode steps over paged KV in one jit call. The slots'
+    pages are gathered ONCE into a contiguous [slots, view_pages*page_size
+    + 1] view (last slot = view trash for parked rows); the scan attends
+    the view and scatters each newly written token's K/V back to its
+    physical page, so the pool is exact when the chunk returns. Liveness
+    (EOS / budget / out-of-room) tracks on device exactly as the dense
+    decode does — the host replays (tokens, valid) identically."""
+    n_flat = (num_pages + 1) * page_size
+    trash_flat = num_pages * page_size
+    V = view_pages * page_size
+    L, kvh, d = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+
+    def paged_decode_fn(params, pool, page_tables, tokens, positions, rng,
+                        temperature, top_k, top_p, eos_ids, remaining,
+                        active):
+        B = tokens.shape[0]
+        quantized = pool.k.dtype == jnp.int8
+        flat_k = pool.k.reshape(L, n_flat, kvh, d)
+        flat_v = pool.v.reshape(L, n_flat, kvh, d)
+        flat_ks = (pool.k_scale.reshape(L, n_flat, kvh)
+                   if quantized else None)
+        flat_vs = (pool.v_scale.reshape(L, n_flat, kvh)
+                   if quantized else None)
+        t = jnp.arange(V, dtype=jnp.int32)
+        fidx = (page_tables[:, t // page_size] * page_size
+                + t % page_size)                             # [B, V]
+        pad5 = [(0, 0), (0, 0), (0, 1), (0, 0), (0, 0)]
+        view_cache = KVCache(
+            k=jnp.pad(flat_k[:, fidx], pad5),
+            v=jnp.pad(flat_v[:, fidx], pad5),
+            index=jnp.zeros((), jnp.int32),
+            k_scale=(jnp.pad(flat_ks[:, fidx], pad5[:-1])
+                     if quantized else None),
+            v_scale=(jnp.pad(flat_vs[:, fidx], pad5[:-1])
+                     if quantized else None))
+        rng, step_rng = jax.random.split(rng)
+        keys = jax.random.split(step_rng, chunk)
+        b_idx = jnp.arange(B, dtype=jnp.int32)
+
+        def body(carry, key):
+            fk, fv, fks, fvs, cache, tok, pos, alive, emitted = carry
+            p = jnp.where(alive, pos, V)   # park at the view trash slot
+            logits, cache = forward(cfg, params, tok[:, None],
+                                    positions=p[:, None], cache=cache)
+            nxt = sample(logits[:, -1], key, temperature, top_k, top_p)
+            nxt = jnp.where(alive, nxt, tok)
+            # Write-back: the token the forward just wrote at p, view ->
+            # physical page. Parked rows write the trash page. Shared
+            # pages are structurally out of reach: alive positions are
+            # >= the prompt length, past every shared (full prompt) page.
+            i4 = p[None, :, None, None]
+            wk = jnp.take_along_axis(cache.k, i4[..., None], axis=2)[:, :, 0]
+            wv = jnp.take_along_axis(cache.v, i4[..., None], axis=2)[:, :, 0]
+            page = page_tables[
+                b_idx, jnp.clip(p // page_size, 0,
+                                page_tables.shape[1] - 1)]
+            fi = jnp.where(alive, page * page_size + p % page_size,
+                           trash_flat)
+            fk = fk.at[:, fi].set(wk)
+            fv = fv.at[:, fi].set(wv)
+            if quantized:
+                wks = jnp.take_along_axis(cache.k_scale, i4,
+                                          axis=2)[:, :, 0]
+                wvs = jnp.take_along_axis(cache.v_scale, i4,
+                                          axis=2)[:, :, 0]
+                fks = fks.at[:, fi].set(wks)
+                fvs = fvs.at[:, fi].set(wvs)
+            out = (nxt, alive)
+            emitted = emitted + alive
+            pos = pos + alive
+            hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
+            alive = (alive & ~hit_eos & (emitted < remaining)
+                     & (pos < max_len))
+            return (fk, fv, fks, fvs, cache, nxt, pos, alive, emitted), out
+
+        init = (flat_k, flat_v, flat_ks, flat_vs, view_cache, tokens,
+                positions, active, jnp.zeros_like(remaining))
+        (fk, fv, fks, fvs, *_), (toks, valid) = jax.lax.scan(
+            body, init, keys)
+        new_pool = PagePool(
+            k=fk.reshape(pool.k.shape), v=fv.reshape(pool.v.shape),
+            k_scale=(fks.reshape(pool.k_scale.shape)
+                     if quantized else None),
+            v_scale=(fvs.reshape(pool.v_scale.shape)
+                     if quantized else None))
+        return toks, valid, new_pool, rng
+
+    return paged_decode_fn
+
+
+# ---------------------------------------------------------------------------
+# Host-side paging state
+# ---------------------------------------------------------------------------
+
+class PagedKVManager:
+    """Allocator + radix tree + per-slot page tables for one engine.
+    Single-threaded like the engine that owns it; the ints /metrics
+    reads are safe to read racily."""
+
+    def __init__(self, num_pages: int, page_size: int, max_slots: int,
+                 max_pages_per_slot: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.max_pages_per_slot = max_pages_per_slot
+        self.allocator = PageAllocator(num_pages)
+        self.radix = RadixTree(page_size, self.allocator)
+        self.trash_page = num_pages
+        self.page_table = np.full((max_slots, max_pages_per_slot),
+                                  self.trash_page, np.int32)
+        self.slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
+        self.slot_shared = np.zeros(max_slots, np.int32)  # leading shared
+        self.pages_reused_total = 0   # radix hits, counted PER PAGE
+
+    def plan(self, prompt_tokens, max_tokens: int,
+             max_seq_len: int) -> Tuple[List[int], int]:
+        """(shared_pages, private_needed) for admitting this prompt.
+        Shared = the radix tree's longest full-page match, capped so at
+        least one prompt token remains to prefill (sampling needs a real
+        suffix logit). Private pages reserve the whole generation up
+        front — ceil(min(prompt+max_tokens, max_seq_len) / page_size)
+        minus the shared pages — so an admitted request can never die
+        mid-generation to page exhaustion (admission is the only
+        backpressure point: no preemption machinery, no corruption)."""
+        ps = self.page_size
+        n = len(prompt_tokens)
+        shareable = ((n - 1) // ps) * ps
+        shared = self.radix.match(prompt_tokens[:shareable])
+        reserve = min(n + max_tokens, max_seq_len)
+        total_pages = -(-reserve // ps)
+        return shared, max(total_pages - len(shared), 0)
+
+    def admit(self, slot: int, shared: List[int],
+              private_n: int) -> Optional[List[int]]:
+        """Commit an admission: evict unreferenced prefix pages if the
+        free list is short, allocate the private pages, take references
+        on the shared ones, and build the slot's page table. Returns the
+        private pages, or None when the pool cannot satisfy the plan
+        (caller leaves the request queued — queue backpressure, not
+        corruption)."""
+        # Pin the matched pages BEFORE evicting: the planned shared
+        # pages may be tree-only (refcount 1) and would otherwise be
+        # legal eviction victims for their own admission.
+        self.allocator.incref(shared)
+        if private_n > self.allocator.free_count:
+            self.radix.evict(private_n - self.allocator.free_count)
+        priv = self.allocator.alloc(private_n)
+        if priv is None:
+            self.allocator.decref(shared)
+            return None
+        pages = list(shared) + priv
+        self.slot_pages[slot] = pages
+        self.slot_shared[slot] = len(shared)
+        self.page_table[slot, :] = self.trash_page
+        self.page_table[slot, :len(pages)] = pages
+        self.pages_reused_total += len(shared)
+        return priv
+
+    def release(self, slot: int, written_tokens=None) -> None:
+        """Drop the slot's page references. With ``written_tokens`` (the
+        finished request's prompt + generated tokens, trimmed to what
+        the cache actually holds), first adopt the completed full pages
+        into the radix tree so the next prompt sharing this prefix —
+        including the next turn of the same chat — reuses them."""
+        pages = self.slot_pages[slot]
+        if not pages:
+            return
+        if written_tokens is not None:
+            self.radix.insert(written_tokens, pages)
+        self.allocator.decref(pages)
+        self.slot_pages[slot] = []
+        self.slot_shared[slot] = 0
+        self.page_table[slot, :] = self.trash_page
+
+    def occupancy(self) -> dict:
+        return {
+            "pages_total": self.num_pages,
+            "pages_free": self.allocator.free_count,
+            "pages_used": self.allocator.used_count,
+            "pages_shared": self.radix.nodes,
+            "pages_reused_total": self.pages_reused_total,
+            "pages_evicted_total": self.radix.pages_evicted,
+        }
+
+
+# ---------------------------------------------------------------------------
+# The paged engine
+# ---------------------------------------------------------------------------
+
+class PagedInferenceEngine(InferenceEngine):
+    """InferenceEngine over a paged pool instead of dense slot rows.
+
+    Same request lifecycle, queueing, deadlines, and latency accounting
+    as the dense engine (inherited); what changes is storage and
+    admission: slots hold page tables into a shared pool, admission
+    gates on page availability (pages, not slots, are the scarce
+    resource), and every finished request's prompt pages feed the radix
+    tree for many-user prefix reuse. ``num_pages`` defaults to the dense
+    engine's worst-case capacity (max_slots * max_seq_len / page_size) —
+    size it DOWN from HBM headroom to overcommit on sharing
+    (docs/paged-kv.md)."""
+
+    def __init__(self, cfg: ModelConfig, params: Params, *,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 **kwargs):
+        if kwargs.get("mesh") is not None:
+            raise ValueError(
+                "paged KV serving does not support a serving mesh yet; "
+                "use the dense engine for sharded serving "
+                "(docs/paged-kv.md)")
+        self.page_size = int(page_size)
+        self._num_pages_arg = num_pages
+        super().__init__(cfg, params, **kwargs)
+
+    # -- storage -------------------------------------------------------
+
+    def _init_cache(self) -> None:
+        ps = self.page_size
+        if ps < 1:
+            raise ValueError(f"page_size must be >= 1, got {ps}")
+        if self.max_seq_len % ps:
+            raise ValueError(
+                f"page_size {ps} must divide max_seq_len "
+                f"{self.max_seq_len} (static page tables assume whole "
+                "pages per slot)")
+        self.pages_per_slot = self.max_seq_len // ps
+        self.num_pages = (int(self._num_pages_arg)
+                          if self._num_pages_arg is not None
+                          else self.max_slots * self.pages_per_slot)
+        if self.num_pages < self.pages_per_slot:
+            raise ValueError(
+                f"num_pages {self.num_pages} cannot hold even one "
+                f"max-length sequence ({self.pages_per_slot} pages)")
+        self.pager = PagedKVManager(self.num_pages, ps, self.max_slots,
+                                    self.pages_per_slot)
+        self.cache = PagePool.create(self.cfg, self.num_pages, ps,
+                                     quantize_kv=self.quantize_kv)
+
+    def reset(self) -> None:
+        """Crash recovery: donated pool buffers may be invalid, so the
+        pool reallocates and ALL paging state resets — the radix tree's
+        pages lived in the doomed pool, so its content goes too."""
+        self.pager = PagedKVManager(self.num_pages, self.page_size,
+                                    self.max_slots, self.pages_per_slot)
+        self.cache = PagePool.create(self.cfg, self.num_pages,
+                                     self.page_size,
+                                     quantize_kv=self.quantize_kv)
+        self.lengths[:] = 0
+        self.active[:] = False
+        self.last_token[:] = 0
+        self.slot_req = [None] * self.max_slots
+        self.queue.clear()
+
+    # -- programs ------------------------------------------------------
+
+    def _init_programs(self) -> None:
+        cfg = self.cfg
+        cache_len = self.max_seq_len + 1
+        self._paged_prefill = jax.jit(
+            make_paged_prefill_fn(cfg, cache_len, self.page_size,
+                                  self.num_pages),
+            donate_argnums=(1,))
+        obs_device.PROGRAMS.register("serve", "paged_prefill",
+                                     self._paged_prefill)
+        self.view_page_buckets = view_page_buckets_for(self.max_seq_len,
+                                                       self.page_size)
+        self._decode_fns: dict = {}
+
+        def decode_for(view_pages: int):
+            if view_pages not in self._decode_fns:
+                self._decode_fns[view_pages] = jax.jit(
+                    make_paged_decode_fn(cfg, self.decode_chunk,
+                                         self.max_seq_len, self.page_size,
+                                         view_pages, self.num_pages),
+                    donate_argnums=(1,))
+                obs_device.PROGRAMS.register(
+                    "serve", f"decode_p{view_pages}",
+                    self._decode_fns[view_pages])
+            return self._decode_fns[view_pages]
+
+        self._decode_for = decode_for
+
+    def _view_pages_for(self, max_pos: int) -> int:
+        """Smallest view-page bucket whose token extent covers every
+        position this chunk can write."""
+        for vp in self.view_page_buckets:
+            if max_pos <= vp * self.page_size:
+                return vp
+        return self.view_page_buckets[-1]
+
+    def warmup(self, rows: Optional[tuple] = None,
+               prefix_build: bool = False) -> None:
+        """Compile the full paged program set ahead of traffic: every
+        reachable (suffix bucket, prefix-page bucket) x row count
+        prefill, plus one decode per view-page bucket. Unlike the dense
+        engine's prefix path (whose plen-keyed splice shapes appear at
+        runtime and warm in the background), the paged prefix-shape set
+        is static — so warmup covers it completely and a radix hit can
+        NEVER compile on the serving thread. prefix_build is accepted
+        for interface compatibility and ignored (prefix registration
+        rides the normal admission path here)."""
+        del prefix_build
+        if rows is None:
+            rows = (1, self.max_slots) if self.max_slots > 1 else (1,)
+        row_set = list(dict.fromkeys(min(r, self.max_slots)
+                                     for r in rows))
+        import os as _os
+
+        capture_costs = _os.environ.get("RBT_DEVICE_OBS", "1") != "0"
+
+        def record_cost(name, sig, fn, *args):
+            if capture_costs:
+                obs_device.program_cost("serve", name, sig, fn, *args)
+
+        sentinel = obs_device.SENTINEL
+        compiles_before = sentinel.total
+        seconds_before = sentinel.compile_seconds
+        t_warm = time.perf_counter()
+        shapes = paged_prefill_shapes(self.prefill_buckets,
+                                      self.pages_per_slot, self.page_size,
+                                      self.max_seq_len)
+        n_prefill = 0
+        trash = self.pager.trash_page
+        with sentinel.expected():
+            for bucket, ppb in shapes:
+                for r in row_set:
+                    tokens = np.zeros((r, bucket), np.int32)
+                    positions = np.full((r, bucket), self._pad_slot,
+                                        np.int32)
+                    dest = np.full((r, self.pages_per_slot), trash,
+                                   np.int32)
+                    args = (jnp.asarray(tokens), jnp.asarray(positions),
+                            jnp.asarray(dest), jnp.zeros(r, jnp.int32),
+                            jax.random.key(0),
+                            jnp.zeros(r, jnp.float32),
+                            jnp.zeros(r, jnp.int32),
+                            jnp.ones(r, jnp.float32))
+                    if ppb:
+                        args = args + (
+                            jnp.full((r, ppb), trash, jnp.int32),
+                            jnp.zeros(r, jnp.int32))
+                    record_cost("paged_prefill", f"b{bucket}r{r}p{ppb}",
+                                self._paged_prefill, self.params,
+                                self.cache, *args)
+                    _, self.cache, _ = self._paged_prefill(
+                        self.params, self.cache, *args)
+                    n_prefill += 1
+            zeros = np.zeros(self.max_slots, np.int32)
+            tables = np.full((self.max_slots, self.pages_per_slot), trash,
+                             np.int32)
+            for vp in self.view_page_buckets:
+                args = (jnp.asarray(tables), jnp.asarray(zeros),
+                        jnp.asarray(zeros), jax.random.key(0),
+                        jnp.zeros(self.max_slots, jnp.float32),
+                        jnp.zeros(self.max_slots, jnp.int32),
+                        jnp.ones(self.max_slots, jnp.float32),
+                        jnp.full(self.max_slots, -1, jnp.int32),
+                        jnp.zeros(self.max_slots, jnp.int32),
+                        jnp.zeros(self.max_slots, bool))
+                record_cost(f"decode_p{vp}", f"p{vp}",
+                            self._decode_for(vp), self.params,
+                            self.cache, *args)
+                _, _, self.cache, _ = self._decode_for(vp)(
+                    self.params, self.cache, *args)
+        census = obs_device.PROGRAMS.census("serve")
+        self.warmup_census = {
+            "prefill_programs": n_prefill,
+            "prefill_buckets": list(self.prefill_buckets),
+            "prefix_page_buckets":
+                [0] + prefix_page_buckets(self.pages_per_slot),
+            "rows": row_set,
+            "decode_views": list(self.view_page_buckets),
+            "page_size": self.page_size,
+            "num_pages": self.num_pages,
+            "compiles": sentinel.total - compiles_before,
+            "compile_seconds": round(
+                sentinel.compile_seconds - seconds_before, 3),
+            "warmup_seconds": round(time.perf_counter() - t_warm, 3),
+            "programs": [{"name": c["name"], "programs": c["programs"]}
+                         for c in census],
+        }
+        print(
+            f"serve: paged warmup census: {n_prefill} prefill programs "
+            f"({len(shapes)} (bucket, prefix-pages) shapes x rows "
+            f"{row_set}), {len(self.view_page_buckets)} decode views "
+            f"(pages {self.view_page_buckets}), "
+            f"{self.num_pages}x{self.page_size} pool; "
+            f"{self.warmup_census['compiles']} compiles in "
+            f"{self.warmup_census['compile_seconds']}s", flush=True)
+        if not self._marked_steady:
+            self._marked_steady = True
+            sentinel.mark_steady("serve")
+        self.reset()
+
+    # -- prefix surface (radix-backed) ---------------------------------
+
+    def _usable_prefix_len(self, tokens) -> int:
+        """Full-page token count a registration/lookup can share, leaving
+        at least one token inside the context window to prefill."""
+        n = min(len(tokens), self.max_seq_len - 1)
+        return (n // self.page_size) * self.page_size
+
+    def register_prefix(self, tokens: List[int], warmup: bool = True) -> int:
+        """Seed the radix tree with a prompt prefix (e.g. a deployment's
+        system prompt) by running it through the NORMAL admission path:
+        a one-token synthetic generation prefills the tokens into pages,
+        and the finish hook adopts the full pages into the tree. Zero
+        dedicated programs, zero compiles beyond the warmed set. Returns
+        the shareable (full-page) length, 0 if too short."""
+        del warmup  # every paged shape is compiled by warmup() already
+        plen = self._usable_prefix_len(tokens)
+        if plen < self.page_size:
+            return 0
+        toks = [int(t) for t in tokens[:self.max_seq_len - 1]]
+        if len(self.pager.radix.match(toks[:plen])) * self.page_size \
+                >= plen:
+            return plen  # already fully resident
+        req = Request(prompt_tokens=toks, max_tokens=1, temperature=0.0)
+        self.validate(req)
+        req._submitted = time.monotonic()
+        # Engine-internal work driven by the worker thread itself:
+        # bypass submit()'s public admission bound — a full queue must
+        # not turn registration into a 429 (the dense engine's
+        # register_prefix cannot fail under load either).
+        self.queue.append(req)
+        # Synchronous: the caller runs on the engine's thread (the
+        # worker's prefix-job path). Other queued traffic keeps being
+        # served by these steps.
+        try:
+            for _ in range(self.max_seq_len * 4):
+                if req.finished:
+                    break
+                self.step()
+        except Exception as exc:  # noqa: BLE001
+            # The donated cache may now be invalid and page refs
+            # half-applied — the worker must doom in-flight requests and
+            # reset(), not swallow this per-job (serve/api.py).
+            raise EngineStepFailed(
+                "jitted step failed during paged prefix "
+                "registration") from exc
+        if req.finished:
+            return plen
+        # Timed out behind sustained traffic: withdraw the synthetic
+        # request so a late completion cannot adopt pages after we
+        # reported failure.
+        try:
+            self.queue.remove(req)
+        except ValueError:
+            pass
+        return 0
+
+    def register_prefix_from_slot(self, slot: int,
+                                  tokens: List[int]) -> int:
+        """No-op: the finish hook already adopted the slot's completed
+        pages into the radix tree — multi-turn reuse needs no explicit
+        lift-out on the paged engine."""
+        return 0
+
+    def has_prefix(self, tokens: List[int]) -> bool:
+        plen = self._usable_prefix_len(tokens)
+        return (plen >= self.page_size
+                and len(self.pager.radix.match(tokens[:plen]))
+                * self.page_size >= plen)
+
+    def prefix_warmup_shapes(self, plen: int) -> List[tuple]:
+        return []  # warmup() compiled the full static set
+
+    def warm_prefix_shape(self, key: tuple, bucket: int, rows: int,
+                          buffers: Optional[tuple] = None):
+        return buffers  # nothing to warm at runtime
+
+    # -- admission -----------------------------------------------------
+
+    def _admit(self, exclude_slots=()) -> None:
+        budget = self.prefill_budget
+        admitted: List[tuple] = []
+        for slot in self._free_slots(exclude_slots):
+            if not self.queue:
+                break
+            head = self.queue[0]
+            shared, private_n = self.pager.plan(
+                head.prompt_tokens, head.max_tokens, self.max_seq_len)
+            suffix = (len(head.prompt_tokens)
+                      - len(shared) * self.page_size)
+            need = self._bucket_for(suffix)
+            if admitted and need > budget:
+                break
+            priv = self.pager.admit(slot, shared, private_n)
+            if priv is None:
+                # Page pressure even after evicting unreferenced prefix
+                # pages: the head waits (FIFO — no starvation of big
+                # requests) and the queue backs up until submit() sheds
+                # with 429. Never admit a request the pool cannot hold.
+                break
+            req = self.queue.pop(0)
+            req._admitted = time.monotonic()
+            obs_metrics.REGISTRY.observe(
+                "serve_queue_wait_seconds",
+                req._admitted - req._submitted,
+                help_text="Admission-queue wait (submit to slot "
+                          "assignment).")
+            if trace_enabled():
+                trace_complete("queue_wait",
+                               req._admitted - req._submitted,
+                               request_id=req.request_id, slot=slot)
+            budget -= need
+            admitted.append((slot, req, len(shared)))
+        if not admitted:
+            return
+        by_group: dict = {}
+        for slot, req, nshared in admitted:
+            b = self._bucket_for(len(req.prompt_tokens)
+                                 - nshared * self.page_size)
+            ppb = page_bucket(nshared, self.pages_per_slot)
+            by_group.setdefault((b, ppb), []).append((slot, req))
+        for (bucket, ppb), group in by_group.items():
+            self._prefill_group_paged(bucket, ppb, group)
+
+    def _prefill_group_paged(self, bucket: int, ppb: int,
+                             group: List[tuple]) -> None:
+        """One batched paged prefill for same-(suffix bucket, prefix-page
+        bucket) admissions. Rows within the group may share DIFFERENT
+        prefixes (or different lengths within the bucket) — the per-row
+        prefix-page and prefix-length operands carry each row's own
+        match, which is what makes this many-user sharing rather than
+        the dense path's one-prefix-per-dispatch."""
+        n = len(group)
+        ps = self.page_size
+        self.prefix_lookups += n
+        rows = 1 if n == 1 else self.max_slots
+        tokens = np.zeros((rows, bucket), np.int32)
+        positions = np.full((rows, bucket), self._pad_slot, np.int32)
+        trash = self.pager.trash_page
+        dest_pages = np.full((rows, self.pages_per_slot), trash, np.int32)
+        prefix_pages = (np.full((rows, ppb), trash, np.int32)
+                        if ppb else None)
+        prefix_len = np.zeros(rows, np.int32) if ppb else None
+        last_pos = np.zeros(rows, np.int32)
+        temps = np.zeros(rows, np.float32)
+        top_ks = np.zeros(rows, np.int32)
+        top_ps = np.ones(rows, np.float32)
+        for i, (slot, req) in enumerate(group):
+            nshared = int(self.pager.slot_shared[slot])
+            plen = nshared * ps
+            m = len(req.prompt_tokens) - plen
+            tokens[i, :m] = req.prompt_tokens[plen:]
+            positions[i, :m] = np.arange(plen, plen + m)
+            dest_pages[i] = self.pager.page_table[slot]
+            if ppb:
+                prefix_pages[i, :nshared] = \
+                    self.pager.slot_pages[slot][:nshared]
+                prefix_len[i] = plen
+            last_pos[i] = m - 1
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+            if nshared:
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += plen
+        args = (jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(dest_pages), jnp.asarray(last_pos), self.rng,
+                jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps))
+        if ppb:
+            args = args + (jnp.asarray(prefix_pages),
+                           jnp.asarray(prefix_len))
+        t_dispatch = time.perf_counter()
+        attrs = ({"request_ids": [r.request_id for _, r in group]}
+                 if trace_enabled() else {})
+        with span("prefill", bucket=bucket, rows=rows,
+                  prefix=ppb * ps, **attrs), \
+                self._mesh_ctx():
+            first, self.cache, self.rng = self._paged_prefill(
+                self.params, self.cache, *args)
+            # rbt-check: ignore[device-sync] prefill dispatch boundary — the first token must reach the host to stream
+            first = np.asarray(first)
+        obs_metrics.REGISTRY.observe(
+            "serve_prefill_dispatch_seconds",
+            time.perf_counter() - t_dispatch, bucket=str(bucket),
+            rows=str(rows),
+            help_text="Prefill dispatch+sync wall time per admission "
+                      "group, labeled by prompt bucket and row count.")
+        for i, (slot, req) in enumerate(group):
+            tok = int(first[i])
+            self.active[slot] = True
+            self.lengths[slot] = len(req.prompt_tokens)
+            self.last_token[slot] = tok
+            self.slot_req[slot] = req
+            req._slot = slot
+            self._record_token(slot, tok)
+
+    # -- lifecycle hooks ----------------------------------------------
+
+    def _on_slot_finished(self, slot: int, req: Request) -> None:
+        """Adopt the finished request's fully written pages into the
+        radix tree, then drop the slot's references. Only pages the
+        cache ACTUALLY holds are insertable: the last sampled token is
+        never written (the next chunk would have written it), so the
+        written extent is prompt + outputs - 1 — inserting past it would
+        share a page whose tail is garbage."""
+        m = len(req.output_tokens)
+        written = len(req.prompt_tokens) + max(0, m - 1)
+        toks = (req.prompt_tokens + req.output_tokens)[:written]
+        self.pager.release(slot, written_tokens=toks)
+
+    # -- decode --------------------------------------------------------
+
+    def step(self) -> int:
+        """Admit (page-gated), run one paged decode chunk, replay on the
+        host. Operand assembly and the chunk replay are the dense
+        engine's shared helpers; only the dispatch differs (page-table
+        operand, page-bucketed view)."""
+        self._admit(exclude_slots=self._expire_deadlines())
+        if not self.active.any():
+            return 0
+        # Inactive rows decode at position 0; their writes land in the
+        # trash page (free slots' page-table rows all point there).
+        positions = np.where(self.active, self.lengths, 0).astype(np.int32)
+        temps, top_ks, top_ps, eos_ids, remaining = \
+            self._sampling_operands()
+        vp = self._view_pages_for(int(self.lengths[self.active].max())
+                                  + self.decode_chunk)
+        t_dispatch = time.perf_counter()
+        with span("decode", view=vp * self.page_size,
+                  **self._decode_span_attrs()), self._mesh_ctx():
+            toks, valid, self.cache, self.rng = self._decode_for(vp)(
+                self.params, self.cache,
+                jnp.asarray(self.pager.page_table),
+                jnp.asarray(self.last_token), jnp.asarray(positions),
+                self.rng, jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), jnp.asarray(eos_ids),
+                jnp.asarray(remaining), jnp.asarray(self.active))
+            # rbt-check: ignore[device-sync] decode-chunk dispatch boundary: one sync per chunk, not per token
+            toks = np.asarray(toks)
+            # rbt-check: ignore[device-sync] same boundary — valid rides the same chunk sync
+            valid = np.asarray(valid)
+        obs_metrics.REGISTRY.observe(
+            "serve_decode_dispatch_seconds",
+            time.perf_counter() - t_dispatch,
+            view=str(vp * self.page_size),
+            help_text="Decode-chunk dispatch+sync wall time, labeled by "
+                      "cache view bucket.")
+        generated = self._replay_chunk(toks, valid)
+        self.steps += 1
+        return generated
+
+    # -- observability -------------------------------------------------
+
+    def kv_occupancy(self) -> dict:
+        """Page-level pool occupancy. occupancy_ratio here is pages
+        used / pages total — physical pressure on the pool (the dense
+        engine reports logical tokens / dense reservation; at equal HBM
+        the paged ratio is what admission actually gates on)."""
+        ps = self.page_size
+        occ = self.pager.occupancy()
+        tokens = (int(self.lengths[self.active].sum())
+                  if self.active.any() else 0)
+        capacity = self.num_pages * ps
+        bpp = self.cache.nbytes // (self.num_pages + 1)
+        return {"slots_total": self.max_slots,
+                "slots_active": int(self.active.sum()),
+                "kv_tokens": tokens,
+                "kv_capacity_tokens": capacity,
+                "occupancy_ratio": (occ["pages_used"] / self.num_pages
+                                    if self.num_pages else 0.0),
+                "paged": True,
+                "page_size": ps,
+                "bytes_per_page": bpp,
+                "kv_bytes_shared": occ["pages_shared"] * bpp,
+                "kv_bytes_private":
+                    (occ["pages_used"] - occ["pages_shared"]) * bpp,
+                **occ}

@@ -17,17 +17,21 @@ def test_single_elector_acquires():
 
 def test_second_elector_waits_then_takes_over():
     client = FakeCluster()
-    e1 = LeaderElector(client, lease_duration_s=1.0, renew_s=0.1)
+    # lease_duration must comfortably exceed the 0.5s observation window
+    # below: a scheduler stall > lease_duration between e1's renewals
+    # (seen >1s under full-suite load on the CPU container) hands e2 the
+    # lease and fails the holder-still-renewing assert.
+    e1 = LeaderElector(client, lease_duration_s=3.0, renew_s=0.1)
     e1.run()
     assert e1.is_leader.wait(timeout=3)
 
-    e2 = LeaderElector(client, lease_duration_s=1.0, renew_s=0.1)
+    e2 = LeaderElector(client, lease_duration_s=3.0, renew_s=0.1)
     e2.run()
     time.sleep(0.5)
     assert not e2.is_leader.is_set()  # holder still renewing
 
     e1.stop()  # leader dies; lease expires after lease_duration
-    deadline = time.time() + 5
+    deadline = time.time() + 12
     while time.time() < deadline and not e2.is_leader.is_set():
         time.sleep(0.1)
     assert e2.is_leader.is_set()
