@@ -25,6 +25,16 @@ both plus the radix-sharing counters. Acceptance: the paged engine
 sustains >= 2x the dense concurrency at equal KV HBM
 (value = concurrency ratio, vs_baseline = ratio / 2) with zero
 unexpected XLA compiles across its steady loop.
+
+RBT_BENCH_ROUTER=1 runs the multi-replica routing axis
+(docs/serving-dataplane.md): the SAME multi-tenant shared-prefix
+workload (P distinct system prompts x M requests each, in waves)
+against 3 paged replicas routed randomly (what a k8s Service does) vs
+prefix-aware (serve/gateway.py's Router with per-replica shadow radix
+indexes), reporting per-replica `serve_prefix_pages_reused_total` per
+routed request for both. Acceptance: prefix-aware routing reuses
+>= 1.5x the pages per request (value = uplift, vs_baseline =
+uplift / 1.5) with zero unexpected XLA compiles throughout.
 """
 
 from __future__ import annotations
@@ -162,6 +172,113 @@ def paged_inner() -> None:
     }))
 
 
+def router_inner() -> None:
+    """Random vs prefix-aware routing over 3 paged replicas.
+
+    The engines are shared between the two runs (engine.reset() between
+    policies rebuilds the pool, radix tree, and reuse counters; the jit
+    cache survives, so the whole comparison costs one warmup per
+    replica). Requests arrive in waves — one request per tenant prefix
+    per wave, waves drained in between — the steady shape of multi-user
+    chat traffic, where each tenant's next turn lands after its last
+    one finished."""
+    import jax
+    import numpy as np
+
+    from runbooks_tpu.obs import device as obs_device
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.serve.engine import Request
+    from runbooks_tpu.serve.gateway import Router, token_blocks
+    from runbooks_tpu.serve.paging import PagedInferenceEngine
+
+    device = jax.devices()[0]
+    on_tpu = ("tpu" in jax.default_backend().lower()
+              or "TPU" in str(device))
+    model = os.environ.get("RBT_BENCH_MODEL",
+                           "bench-410m" if on_tpu else "debug")
+    replicas = int(os.environ.get("RBT_BENCH_REPLICAS", 3))
+    max_seq = int(os.environ.get("RBT_BENCH_MAXSEQ", 64))
+    page_size = int(os.environ.get("RBT_BENCH_PAGE_SIZE", 16))
+    prefixes = int(os.environ.get("RBT_BENCH_PREFIXES", 8))
+    waves = int(os.environ.get("RBT_BENCH_WAVES", 4))
+    max_tokens = int(os.environ.get("RBT_BENCH_MAXTOK", 4))
+
+    cfg = get_config(model, param_dtype="bfloat16")
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+    engines = {}
+    for i in range(replicas):
+        eng = PagedInferenceEngine(
+            cfg, params, max_slots=4, max_seq_len=max_seq,
+            page_size=page_size, num_pages=64, max_queue=64)
+        eng.warmup()
+        engines[f"r{i}"] = eng
+
+    rng = np.random.default_rng(0)
+    # 2 full pages of shared prefix per tenant + a short private suffix.
+    prefix_toks = [rng.integers(1, cfg.vocab_size,
+                                2 * page_size).tolist()
+                   for _ in range(prefixes)]
+
+    def run_policy(policy: str):
+        router = Router({n: f"mem://{n}" for n in engines},
+                        policy=policy)
+        routed = 0
+        for _ in range(waves):
+            pending = []
+            for p in range(prefixes):
+                toks = prefix_toks[p] + rng.integers(
+                    1, cfg.vocab_size, 8).tolist()
+                blocks = token_blocks(toks, page_size)
+                name = router.pick(blocks)[0][0]
+                req = Request(prompt_tokens=toks, max_tokens=max_tokens,
+                              temperature=0.0)
+                engines[name].submit(req)
+                router.inflight_add(name, 1)
+                router.record_route(name, blocks)
+                pending.append((name, req))
+                routed += 1
+            for _ in range(100000):
+                busy = [e for e in engines.values() if e.has_work()]
+                if not busy:
+                    break
+                for e in busy:
+                    e.step()
+            else:
+                raise RuntimeError("router bench wave did not converge")
+            for name, _req in pending:
+                router.inflight_add(name, -1)
+        per_replica = {n: e.pager.occupancy()["pages_reused_total"]
+                       for n, e in engines.items()}
+        return sum(per_replica.values()) / max(routed, 1), per_replica
+
+    unexpected_before = obs_device.SENTINEL.unexpected
+    random_reuse, random_detail = run_policy("random")
+    for eng in engines.values():
+        eng.reset()  # fresh pool + radix + counters; jit cache survives
+    prefix_reuse, prefix_detail = run_policy("prefix")
+    unexpected = obs_device.SENTINEL.unexpected - unexpected_before
+
+    uplift = prefix_reuse / max(random_reuse, 1e-9)
+    print(json.dumps({
+        "metric": f"{model} prefix-aware vs random routing page reuse "
+                  f"({replicas} replicas, {prefixes} prefixes x "
+                  f"{waves} waves)",
+        "value": round(uplift, 2),
+        "unit": "x",
+        # Acceptance: >= 1.5x pages reused per routed request
+        # (docs/serving-dataplane.md), so > 1.0 means the claim holds.
+        "vs_baseline": round(uplift / 1.5, 4),
+        "prefix_pages_reused_per_request": round(prefix_reuse, 3),
+        "random_pages_reused_per_request": round(random_reuse, 3),
+        "prefix_per_replica": prefix_detail,
+        "random_per_replica": random_detail,
+        "unexpected_compiles": unexpected,
+        "platform": jax.default_backend(),
+        "device": str(device),
+    }))
+
+
 def inner() -> None:
     import jax
     import numpy as np
@@ -293,11 +410,18 @@ def inner() -> None:
 
 if __name__ == "__main__":
     paged_axis = os.environ.get("RBT_BENCH_PAGED") == "1"
+    router_axis = os.environ.get("RBT_BENCH_ROUTER") == "1"
     if "--inner" in sys.argv:
-        paged_inner() if paged_axis else inner()
+        if router_axis:
+            router_inner()
+        elif paged_axis:
+            paged_inner()
+        else:
+            inner()
     else:
         import benchkit
         benchkit.run_outer(
             os.path.abspath(__file__),
-            *(("paged KV concurrency vs dense", "x") if paged_axis
+            *(("prefix-aware vs random routing", "x") if router_axis
+              else ("paged KV concurrency vs dense", "x") if paged_axis
               else ("serve TTFT p50", "ms")))

@@ -134,6 +134,16 @@ run serve-quant-int4 env RBT_BENCH_QUANTIZE=int4 python bench_serve.py
 #      zero-unexpected-compiles steady-loop gate in the same JSON line.
 run serve-paged env RBT_BENCH_PAGED=1 python bench_serve.py
 
+# 4a3. Serving data plane (docs/serving-dataplane.md): prefix-aware vs
+#      random routing over 3 paged replicas on the shared-prefix
+#      multi-tenant workload — value is the per-replica
+#      serve_prefix_pages_reused_total per routed request uplift
+#      (acceptance >= 1.5x, vs_baseline = uplift/1.5), zero unexpected
+#      compiles throughout. The smoke is the same claim through the
+#      REAL HTTP stack: 3 aiohttp replicas behind the real gateway.
+run serve-router env RBT_BENCH_ROUTER=1 python bench_serve.py
+run gateway-smoke python tools/gateway_smoke.py 3
+
 # 4b. Observability instrumentation overhead (docs/observability.md):
 #     the per-step cost of the obs subsystem (spans + histogram observes +
 #     goodput update) as a percent of the real step time, PLUS the fleet-

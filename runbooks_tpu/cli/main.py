@@ -753,9 +753,39 @@ def _top_rows_from_metrics(text: str):
     return header, rows
 
 
+def _top_gateway_detail(families, sel: dict) -> str:
+    """DETAIL cell for a gateway replica (serve/gateway.py): routed
+    volume, affinity hit rate, failover retries, healthy backends."""
+    routed = _metric_sum(families, "gateway_requests_total", sel)
+    if routed is None:
+        return ""
+    parts = [f"routed={routed:.0f}"]
+    healthy = _metric_value(families, "gateway_replicas_healthy", sel)
+    if healthy is not None:
+        parts.append(f"backends={healthy:.0f}")
+    aff_req = _metric_sum(families, "gateway_affinity_requests_total",
+                          sel)
+    aff_hit = _metric_sum(families, "gateway_affinity_hits_total", sel)
+    if aff_req:
+        parts.append(f"affinity={(aff_hit or 0) / aff_req * 100:.0f}%")
+    retries = _metric_sum(families, "gateway_retries_total", sel)
+    if retries:
+        parts.append(f"retries={retries:.0f}")
+    p90 = _metric_quantile_ms(families, "gateway_proxy_latency_seconds",
+                              0.90, sel)
+    if p90 is not None:
+        parts.append(f"proxy90={p90:.1f}ms")
+    return " ".join(parts)
+
+
 def _top_detail(families, kind: str, sel: dict) -> str:
     parts = []
     if kind == "Server":
+        gw = _top_gateway_detail(families, sel)
+        if gw:
+            # A gateway pod exports gateway_* instead of engine load; its
+            # row reads routing stats where replicas read slots/queue.
+            return gw
         slots = _metric_value(families, "serve_active_slots", sel)
         queue = _metric_value(families, "serve_queue_depth", sel)
         qw = _metric_quantile_ms(families, "serve_queue_wait_seconds",

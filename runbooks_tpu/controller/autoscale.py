@@ -1,0 +1,185 @@
+"""Metrics-driven replica autoscaling for Servers.
+
+The Server reconciler calls :func:`evaluate` every reconcile when
+``spec.autoscale`` is present; the decision consumes the SAME fleet
+telemetry the SLO conditions do (controller/fleet.py — merged queue-wait
+p90, active slots, queue depth per replica) and drives the Deployment's
+replica count between ``minReplicas`` and ``maxReplicas``:
+
+- **scale out** on sustained queue-wait p90 above target (the explicit
+  ``queueWaitP90Ms`` knob, defaulting to ``spec.slo.queueWaitP90Ms``) or
+  a sustained SLOViolated condition — one replica per action;
+- **scale in** on sustained idle capacity: queue empty AND the fleet's
+  active slots would fit in one fewer replica at ``scaleInOccupancy``
+  (default 0.5) of per-replica slot capacity;
+- **cooldown** between actions (default 60 s) so one burst cannot ladder
+  straight to maxReplicas and back (flapping triage:
+  docs/troubleshooting.md);
+- **staleness guard**: no action when the freshest replica scrape is
+  older than two scrape intervals — acting on a dead telemetry plane is
+  how autoscalers kill healthy fleets. Sustain onsets reset on stale
+  data, so a telemetry outage cannot bank "sustained" time.
+
+State (desired count, onset clocks, cooldown) lives in the in-process
+:data:`AUTOSCALE` book, same pattern as the FLEET state: the reconciler
+is the only writer, `.status.autoscale` mirrors it for operators.
+Knobs and interplay with ``spec.slo``: docs/serving-dataplane.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+DEFAULT_SCALE_OUT_SUSTAIN_S = 15.0
+DEFAULT_SCALE_IN_SUSTAIN_S = 60.0
+DEFAULT_COOLDOWN_S = 60.0
+DEFAULT_SCALE_IN_OCCUPANCY = 0.5
+
+# Overridable clock (tests pin it; the reconciler never passes one).
+_now = time.monotonic
+
+Key = Tuple[str, str]  # namespace, name
+
+
+@dataclasses.dataclass
+class ScaleState:
+    """Per-Server autoscaler memory between reconciles."""
+    desired: Optional[int] = None
+    last_action_t: Optional[float] = None
+    last_action: str = ""        # "out" | "in" | ""
+    last_reason: str = ""
+    out_since: Optional[float] = None
+    in_since: Optional[float] = None
+    held_stale: bool = False     # last evaluation skipped on staleness
+
+
+class AutoscaleBook:
+    """Thread-safe store of per-Server scale state (reconciler-written,
+    tests reset it)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._states: Dict[Key, ScaleState] = {}   # guarded-by: _lock
+
+    def state_for(self, key: Key) -> ScaleState:
+        with self._lock:
+            return self._states.setdefault(key, ScaleState())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._states.clear()
+
+
+AUTOSCALE = AutoscaleBook()
+
+
+def _knob(spec: dict, key: str, default: float) -> float:
+    val = spec.get(key)
+    return float(val) if val is not None else default
+
+
+def evaluate(key: Key, spec: dict, slo: dict, summary: Optional[dict],
+             slo_violated: bool, scrape_age: Optional[float],
+             max_scrape_age: float, base_replicas: int,
+             ) -> Tuple[int, Optional[dict]]:
+    """One autoscale decision. Returns (desired_replicas, action) where
+    action is None or {"direction": "out"|"in", "reason": str} when this
+    call actually moved the target.
+
+    ``summary`` is FleetState.server_summary's dict (or None before any
+    scrape); ``scrape_age`` the freshest replica scrape age in seconds
+    (None = never scraped); ``base_replicas`` seeds the target from
+    ``spec.replicas`` on the first evaluation."""
+    st = AUTOSCALE.state_for(key)
+    mn = max(1, int(spec.get("minReplicas", 1)))
+    mx = int(spec.get("maxReplicas", mn))
+    if st.desired is None:
+        st.desired = min(max(int(base_replicas), mn), mx)
+    else:
+        # A spec edit moved the bounds: re-clamp the live target.
+        st.desired = min(max(st.desired, mn), mx)
+    now = _now()
+
+    # Staleness guard: no fresh telemetry -> hold position, reset the
+    # sustain clocks (an outage must not bank "sustained" pressure).
+    if (summary is None or not summary.get("replicasUp")
+            or scrape_age is None or scrape_age > max_scrape_age):
+        st.out_since = st.in_since = None
+        st.held_stale = True
+        return st.desired, None
+    st.held_stale = False
+
+    qw_target = spec.get("queueWaitP90Ms",
+                         (slo or {}).get("queueWaitP90Ms"))
+    qw = summary.get("queueWaitP90Ms")
+    overloaded = bool(slo_violated) or (
+        qw_target is not None and qw is not None
+        and float(qw) > float(qw_target))
+
+    active = float(summary.get("activeSlots", 0) or 0)
+    queue = float(summary.get("queueDepth", 0) or 0)
+    slots_total = summary.get("slotsTotal")
+    up = max(int(summary.get("replicasUp", 1)), 1)
+    idle = False
+    if not overloaded and queue == 0 and st.desired > mn:
+        if slots_total:
+            per_replica = float(slots_total) / up
+            occupancy = _knob(spec, "scaleInOccupancy",
+                              DEFAULT_SCALE_IN_OCCUPANCY)
+            idle = active <= (st.desired - 1) * per_replica * occupancy
+        else:
+            idle = active == 0
+
+    if overloaded:
+        st.out_since = st.out_since if st.out_since is not None else now
+        st.in_since = None
+    elif idle:
+        st.in_since = st.in_since if st.in_since is not None else now
+        st.out_since = None
+    else:
+        st.out_since = st.in_since = None
+
+    cooldown = _knob(spec, "cooldownS", DEFAULT_COOLDOWN_S)
+    in_cooldown = (st.last_action_t is not None
+                   and now - st.last_action_t < cooldown)
+    action = None
+    if (st.out_since is not None and st.desired < mx and not in_cooldown
+            and now - st.out_since >= _knob(spec, "scaleOutSustainS",
+                                            DEFAULT_SCALE_OUT_SUSTAIN_S)):
+        st.desired += 1
+        reason = ("SLOViolated" if slo_violated and (
+            qw_target is None or qw is None or float(qw) <= float(qw_target))
+            else f"queueWaitP90Ms {qw} > target {qw_target}")
+        action = {"direction": "out", "reason": reason}
+        # Re-arm: the pressure must sustain AGAIN before the next step,
+        # on top of the cooldown — one long burst steps, not jumps.
+        st.out_since = now
+    elif (st.in_since is not None and st.desired > mn and not in_cooldown
+          and now - st.in_since >= _knob(spec, "scaleInSustainS",
+                                         DEFAULT_SCALE_IN_SUSTAIN_S)):
+        st.desired -= 1
+        action = {"direction": "in",
+                  "reason": f"idle: activeSlots {active:g} with queue "
+                            "empty"}
+        st.in_since = now
+    if action is not None:
+        st.last_action_t = now
+        st.last_action = action["direction"]
+        st.last_reason = action["reason"]
+    return st.desired, action
+
+
+def status_block(key: Key, mn: int, mx: int) -> dict:
+    """.status.autoscale payload mirroring the in-process state."""
+    st = AUTOSCALE.state_for(key)
+    out = {"desiredReplicas": st.desired,
+           "minReplicas": mn, "maxReplicas": mx}
+    if st.last_action:
+        out["lastAction"] = st.last_action
+        out["lastReason"] = st.last_reason
+    if st.held_stale:
+        out["heldStaleTelemetry"] = True
+    return out

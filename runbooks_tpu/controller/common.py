@@ -159,6 +159,84 @@ def validate_slo(slo) -> Optional[str]:
     return None
 
 
+# Server.spec.gateway (serve/gateway.py, docs/serving-dataplane.md): the
+# prefix-aware routing data plane the reconciler deploys in front of the
+# replicas. Validated like spec.slo — a typo'd knob must surface as a
+# condition, not a crash-looping gateway Deployment.
+GATEWAY_KEYS = {
+    "enabled": None,                 # truthy flag
+    "replicas": ("int", 1),
+    "policy": ("enum", ("prefix", "random")),
+    "blockChars": ("int", 8),
+    "sessionAffinity": None,         # truthy flag
+}
+
+# Server.spec.autoscale (controller/autoscale.py): replica autoscaling
+# knobs. minReplicas/maxReplicas bound the range; the rest tune the
+# sustain/cooldown behavior.
+AUTOSCALE_KEYS = {
+    "minReplicas": ("int", 1),
+    "maxReplicas": ("int", 1),
+    "queueWaitP90Ms": ("float", 0.0, False),   # > 0
+    "scaleOutSustainS": ("float", 0.0, True),  # >= 0
+    "scaleInSustainS": ("float", 0.0, True),
+    "cooldownS": ("float", 0.0, True),
+    "scaleInOccupancy": ("float", 0.0, False),
+}
+
+
+def _validate_block(block, prefix: str, keys: dict) -> Optional[str]:
+    if block is None:
+        return None
+    if not isinstance(block, dict):
+        return f"{prefix}: must be a mapping"
+    for key, val in block.items():
+        rule = keys.get(key, "unknown")
+        if rule == "unknown":
+            return (f"{prefix}.{key}: unknown field (expected one of "
+                    f"{'|'.join(sorted(keys))})")
+        if rule is None:
+            continue
+        if rule[0] == "enum":
+            if str(val) not in rule[1]:
+                return (f"{prefix}.{key}: {val!r} is not one of "
+                        f"{'|'.join(rule[1])}")
+            continue
+        kind, lo = rule[0], rule[1]
+        inclusive = rule[2] if len(rule) > 2 else True
+        try:
+            num = int(val) if kind == "int" else float(val)
+        except (TypeError, ValueError):
+            return (f"{prefix}.{key}: {val!r} is not "
+                    f"{'an integer' if kind == 'int' else 'a number'}")
+        if (num < lo) if inclusive else (num <= lo):
+            op = ">=" if inclusive else ">"
+            return f"{prefix}.{key}: {val} must be {op} {lo}"
+    return None
+
+
+def validate_gateway(gateway) -> Optional[str]:
+    """First validation error in a Server spec.gateway block, or None."""
+    return _validate_block(gateway, "spec.gateway", GATEWAY_KEYS)
+
+
+def validate_autoscale(autoscale) -> Optional[str]:
+    """First validation error in a Server spec.autoscale block, or
+    None. maxReplicas is required (an unbounded autoscaler is a billing
+    incident) and must not be below minReplicas."""
+    err = _validate_block(autoscale, "spec.autoscale", AUTOSCALE_KEYS)
+    if err is not None or autoscale is None:
+        return err
+    if autoscale.get("maxReplicas") is None:
+        return "spec.autoscale.maxReplicas: required"
+    mn = int(autoscale.get("minReplicas", 1))
+    mx = int(autoscale["maxReplicas"])
+    if mx < mn:
+        return (f"spec.autoscale.maxReplicas: {mx} must be >= "
+                f"minReplicas {mn}")
+    return None
+
+
 def resolve_preemption_restarts(params: dict,
                                 default: int = DEFAULT_PREEMPTION_RESTARTS,
                                 ) -> int:

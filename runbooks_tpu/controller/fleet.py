@@ -46,8 +46,9 @@ SCRAPE_KINDS: Tuple[Tuple[str, str], ...] = (("Server", "server"),
 # controller's) must not mirror mirrors. xla_*/device_* (obs/device.py:
 # compile sentinel, HBM gauges, program roofline) mirror so per-replica
 # HBM headroom and unexpected-compile storms are visible from the single
-# fleet scrape point.
-MIRROR_PREFIXES = ("serve_", "train_", "xla_", "device_")
+# fleet scrape point. gateway_* (serve/gateway.py) makes the routing
+# data plane's decisions/affinity/latency visible the same way.
+MIRROR_PREFIXES = ("serve_", "train_", "xla_", "device_", "gateway_")
 
 METRICS_PORT_ANNOTATION = "runbooks-tpu.dev/metrics-port"
 DEFAULT_METRICS_PORT = 8080
@@ -69,6 +70,7 @@ class ReplicaSample:
     last_success: Optional[float] = None   # monotonic
     tokens_total: Optional[float] = None   # previous counter, for the rate
     tokens_per_sec: float = 0.0
+    role: str = "run"                      # pod role label (run|gateway)
 
 
 class FleetState:
@@ -108,6 +110,44 @@ class FleetState:
                     del self._workloads[key]
         return dropped
 
+    def retain(self, key: WorkloadKey, live_replicas) -> List[str]:
+        """Drop ONE workload's role=run samples for replicas not in
+        ``live_replicas``; returns the dropped pod names. The Server
+        reconciler calls this before an autoscale decision: a replica
+        that vanished during scale-in keeps its last sample (up=True,
+        stale queue-wait distribution) until the next scrape sweep
+        notices, and those dead-pod samples would bias the fleet's
+        queue-wait p90 exactly when the autoscaler reads it. Non-run
+        samples (the gateway pod shares this workload key) are never
+        dropped here — the caller's live set is built from role=run
+        pods, and pruning the gateway's sample on every reconcile would
+        blank its mirrored series between scrape sweeps."""
+        live = set(live_replicas)
+        dropped: List[str] = []
+        with self._lock:
+            reps = self._workloads.get(key)
+            if not reps:
+                return dropped
+            for rep, sample in list(reps.items()):
+                if sample.role == "run" and rep not in live:
+                    del reps[rep]
+                    dropped.append(rep)
+            if not reps:
+                del self._workloads[key]
+        return dropped
+
+    def scrape_age(self, key: WorkloadKey) -> Optional[float]:
+        """Seconds since the FRESHEST successful scrape of any of the
+        workload's replicas, or None when nothing was ever scraped —
+        the autoscaler's staleness guard (never act on telemetry older
+        than two scrape intervals)."""
+        now = time.monotonic()
+        with self._lock:
+            ages = [now - s.last_success
+                    for s in self._workloads.get(key, {}).values()
+                    if s.last_success is not None]
+        return min(ages) if ages else None
+
     def replicas(self, kind: str, namespace: str,
                  name: str) -> Dict[str, ReplicaSample]:
         with self._lock:
@@ -119,7 +159,12 @@ class FleetState:
         """Cross-replica load summary for a Server, or None when no
         replica has ever been scraped. Histograms merge across replicas
         (same bucket bounds) before the quantile estimate."""
-        reps = self.replicas("Server", namespace, name)
+        # Gateway pods scrape into the same workload key but are the
+        # data plane, not serving capacity: the load/SLO aggregates (and
+        # the autoscaler's per-replica math) must only see role=run.
+        reps = {r: s for r, s in
+                self.replicas("Server", namespace, name).items()
+                if s.role == "run"}
         if not reps:
             return None
         up = [s for s in reps.values() if s.up]
@@ -144,6 +189,11 @@ class FleetState:
 
         out["activeSlots"] = int(total("serve_active_slots"))
         out["queueDepth"] = int(total("serve_queue_depth"))
+        slots_total = total("serve_slots_total")
+        if slots_total:
+            # Fleet slot capacity (engines export it since PR 7): the
+            # autoscaler's scale-in occupancy math divides by it.
+            out["slotsTotal"] = int(slots_total)
         out["tokensPerSec"] = round(sum(s.tokens_per_sec for s in up), 1)
         requests = total("serve_requests_total")
         out["requestsTotal"] = int(requests)
@@ -237,14 +287,27 @@ class FleetScraper:
     def _discover(self) -> List[Tuple[WorkloadKey, dict]]:
         out: List[Tuple[WorkloadKey, dict]] = []
         for kind, label in SCRAPE_KINDS:
+            # Server data planes also expose /metrics (role=gateway pods,
+            # serve/gateway.py): scraped into the same workload key so
+            # routing decisions/affinity show up in `rbt top` beside the
+            # replicas they route to.
+            roles = ("run", "gateway") if kind == "Server" else ("run",)
             for obj in self.ctx.client.list(API_VERSION, kind):
                 ns, name = ko.namespace(obj), ko.name(obj)
-                for pod in self.ctx.client.list(
-                        "v1", "Pod", namespace=ns,
-                        label_selector={label: name, "role": "run"}):
-                    phase = ko.deep_get(pod, "status", "phase", default="")
-                    if phase == "Running":
-                        out.append(((kind, ns, name), pod))
+                for role in roles:
+                    for pod in self.ctx.client.list(
+                            "v1", "Pod", namespace=ns,
+                            label_selector={label: name, "role": role}):
+                        phase = ko.deep_get(pod, "status", "phase",
+                                            default="")
+                        # A Terminating pod (scale-in victim) still
+                        # reports phase Running; scraping it would keep
+                        # its load in the fleet means while it drains.
+                        deleting = ko.deep_get(pod, "metadata",
+                                               "deletionTimestamp",
+                                               default=None)
+                        if phase == "Running" and not deleting:
+                            out.append(((kind, ns, name), pod))
         return out
 
     # -- scrape + mirror ------------------------------------------------
@@ -272,6 +335,7 @@ class FleetScraper:
     def _scrape_replica(self, key: WorkloadKey, pod: dict) -> bool:
         kind, ns, name = key
         replica = ko.name(pod)
+        role = ko.labels(pod).get("role", "run")
         prev = self.state.get_sample(key, replica)
         url = self._pod_url(pod)
         text = None
@@ -290,7 +354,8 @@ class FleetScraper:
                 print(f"fleet: scrape of {kind.lower()}s/{name} pod "
                       f"{replica} failed ({url}); marking down", flush=True)
             sample = (dataclasses.replace(prev, up=False, tokens_per_sec=0.0)
-                      if prev is not None else ReplicaSample(replica))
+                      if prev is not None
+                      else ReplicaSample(replica, role=role))
             self.state.update(key, sample)
             self.registry.set_gauge(
                 "fleet_scrape_up", 0,
@@ -323,7 +388,8 @@ class FleetScraper:
                     tokens_per_sec = delta / dt
         self.state.update(key, ReplicaSample(
             replica=replica, up=True, families=families, last_success=now,
-            tokens_total=tokens_total, tokens_per_sec=tokens_per_sec))
+            tokens_total=tokens_total, tokens_per_sec=tokens_per_sec,
+            role=role))
         self._mirror(families, labels)
         self.registry.set_gauge("fleet_scrape_up", 1, **labels)
         self.registry.set_gauge("fleet_scrape_age_seconds", 0.0, **labels)

@@ -12,6 +12,8 @@ multi-host serving engine).
 
 from __future__ import annotations
 
+from typing import Optional
+
 from runbooks_tpu.api import conditions as cond
 from runbooks_tpu.api.types import Server
 from runbooks_tpu.cloud.base import BucketMount
@@ -28,6 +30,8 @@ from runbooks_tpu.controller.common import (
     reconcile_params_configmap,
     reconcile_service_account,
     resolve_env,
+    validate_autoscale,
+    validate_gateway,
     validate_params,
     validate_slo,
 )
@@ -35,9 +39,11 @@ from runbooks_tpu.controller.manager import Ctx, Result
 from runbooks_tpu.k8s import objects as ko
 
 SERVE_PORT = 8080
+GATEWAY_PORT = 8080
 
 # How often a Server with spec.slo re-reconciles so the condition tracks
-# fresh scrapes even with no spec/dependency events.
+# fresh scrapes even with no spec/dependency events. Autoscaling Servers
+# share the cadence: sustain/cooldown windows need regular evaluation.
 SLO_REQUEUE_S = 5.0
 
 
@@ -49,7 +55,9 @@ class ServerReconciler:
         if not server.image:
             return Result(requeue_after=1.0)
         err = validate_params(server.params) \
-            or validate_slo(server.spec.get("slo"))
+            or validate_slo(server.spec.get("slo")) \
+            or validate_gateway(server.spec.get("gateway")) \
+            or validate_autoscale(server.spec.get("autoscale"))
         if err is not None:
             # Invalid spec.params (e.g. quantize: int3): surface a condition
             # instead of shipping a params.json the serve container will
@@ -80,36 +88,163 @@ class ServerReconciler:
         ko.set_owner(svc, server.obj)
         ctx.client.apply(svc, FIELD_MANAGER)
 
-        dep = self._deployment(ctx, server, model)
+        # Fleet telemetry + SLOs (controller/fleet.py): the scrape loop
+        # populates FLEET between reconciles; this pass only folds the
+        # latest aggregate into .status.telemetry and the SLOViolated
+        # condition — no network from the reconciler itself. Runs BEFORE
+        # the autoscale decision so the decision sees this reconcile's
+        # verdict, not the last one's.
+        changed = self._apply_telemetry_and_slo(server)
+
+        autoscale_spec = server.spec.get("autoscale") or {}
+        replicas = server.spec.get("replicas", 1)
+        desired = replicas
+        if autoscale_spec:
+            desired, aschanged = self._autoscale(ctx, server,
+                                                 autoscale_spec)
+            changed |= aschanged
+
+        dep = self._deployment(ctx, server, model, replicas=desired)
         ko.set_owner(dep, server.obj)
         ctx.client.apply(dep, FIELD_MANAGER)
+
+        gateway_spec = server.spec.get("gateway") or {}
+        gateway_enabled = bool(gateway_spec.get("enabled"))
+        gw_ready = True
+        if gateway_enabled:
+            gw_svc = self._gateway_service(server)
+            ko.set_owner(gw_svc, server.obj)
+            ctx.client.apply(gw_svc, FIELD_MANAGER)
+            gw_dep = self._gateway_deployment(server, gateway_spec)
+            ko.set_owner(gw_dep, server.obj)
+            ctx.client.apply(gw_dep, FIELD_MANAGER)
+            gw_cur = ctx.client.get("apps/v1", "Deployment",
+                                    server.namespace,
+                                    f"{server.name}-gateway")
+            gw_ready = (ko.deep_get(gw_cur, "status", "readyReplicas",
+                                    default=0) or 0) >= 1
+        elif ctx.client.get("apps/v1", "Deployment", server.namespace,
+                            f"{server.name}-gateway") is not None:
+            # spec.gateway.enabled flipped off: a stale gateway left
+            # running would keep routing (with frozen config — it is no
+            # longer re-applied) while the spec says it must not exist.
+            ctx.client.delete("apps/v1", "Deployment", server.namespace,
+                              f"{server.name}-gateway")
+            ctx.client.delete("v1", "Service", server.namespace,
+                              f"{server.name}-gateway")
 
         current = ctx.client.get("apps/v1", "Deployment", server.namespace,
                                  server.name)
         ready_replicas = ko.deep_get(current, "status", "readyReplicas",
                                      default=0) or 0
-        replicas = server.spec.get("replicas", 1)
-        serving = ready_replicas >= max(1, replicas)
-        changed = server.set_condition(
+        # Serving gate. Without autoscaling: every requested replica must
+        # be ready (unchanged semantics). With autoscaling the target
+        # moves under the Deployment, so gating on spec.replicas (or the
+        # instantaneous desired count mid-transition) would flip a
+        # healthy Server to not-serving during every scale event; the
+        # floor the autoscaler guarantees (minReplicas) is the real
+        # availability contract. With the gateway enabled, the ONLY
+        # ingress path is the gateway — a Server whose gateway Deployment
+        # is down is not serving no matter how many replicas are ready.
+        if autoscale_spec:
+            needed = max(1, int(autoscale_spec.get("minReplicas", 1)))
+        else:
+            needed = max(1, replicas)
+        replicas_ok = ready_replicas >= needed
+        serving = replicas_ok and gw_ready
+        if not replicas_ok:
+            message = f"{ready_replicas}/{needed} replicas ready"
+            if autoscale_spec:
+                message += f" (autoscale target {desired})"
+        elif not gw_ready:
+            message = "replicas ready but gateway Deployment is not"
+        else:
+            message = f"{ready_replicas}/{desired} replicas ready"
+            if gateway_enabled:
+                message += ", gateway ready"
+        changed |= server.set_condition(
             cond.SERVING, serving,
             cond.REASON_DEPLOYMENT_READY if serving
-            else cond.REASON_DEPLOYMENT_NOT_READY,
-            f"{ready_replicas}/{replicas} replicas ready")
+            else cond.REASON_DEPLOYMENT_NOT_READY, message)
         if server.ready != serving:
             server.set_ready(serving)
             changed = True
-        # Fleet telemetry + SLOs (controller/fleet.py): the scrape loop
-        # populates FLEET between reconciles; this pass only folds the
-        # latest aggregate into .status.telemetry and the SLOViolated
-        # condition — no network from the reconciler itself.
-        changed |= self._apply_telemetry_and_slo(server)
         if changed:
             server.commit_status(ctx.client)
         requeue = None if serving else 2.0
-        if server.spec.get("slo"):
+        if server.spec.get("slo") or autoscale_spec:
             requeue = (SLO_REQUEUE_S if requeue is None
                        else min(requeue, SLO_REQUEUE_S))
         return Result(requeue_after=requeue)
+
+    # ------------------------------------------------------------------
+
+    def _autoscale(self, ctx: Ctx, server: Server,
+                   spec: dict) -> tuple:
+        """One autoscale evaluation (controller/autoscale.py). Returns
+        (desired_replicas, status_changed)."""
+        from runbooks_tpu.controller import autoscale as autoscale_mod
+        from runbooks_tpu.controller.fleet import (
+            DEFAULT_INTERVAL_S,
+            FLEET,
+        )
+        from runbooks_tpu.controller.metrics import REGISTRY
+
+        key = ("Server", server.namespace, server.name)
+        # Scale-in hygiene (the fleet scraper only prunes on its own
+        # sweep cadence): drop samples for replica pods that no longer
+        # exist or are terminating, so the p90 the decision reads is not
+        # biased toward dead pods' last distributions.
+        live = []
+        for pod in ctx.client.list("v1", "Pod", namespace=server.namespace,
+                                   label_selector={"server": server.name,
+                                                   "role": "run"}):
+            if not ko.deep_get(pod, "metadata", "deletionTimestamp",
+                               default=None):
+                live.append(ko.name(pod))
+        for rep in FLEET.retain(key, live):
+            REGISTRY.drop_series(replica=rep)
+
+        import os
+
+        try:
+            interval = float(os.environ.get("FLEET_SCRAPE_SECONDS",
+                                            str(DEFAULT_INTERVAL_S)))
+        except ValueError:
+            interval = DEFAULT_INTERVAL_S
+        # Seed from the .status.autoscale mirror when present: AUTOSCALE
+        # is in-process state, so after a controller restart a fresh
+        # ScaleState seeding from spec.replicas would instantly discard
+        # scaled-out capacity (replicas=1, desired was 4 -> Deployment
+        # snapped back to 1 under load). The status mirror lives on the
+        # CR and survives the restart; evaluate() clamps it to the
+        # current min/max bounds.
+        base = (server.status.get("autoscale") or {}).get(
+            "desiredReplicas") or server.spec.get("replicas", 1)
+        desired, action = autoscale_mod.evaluate(
+            (server.namespace, server.name), spec,
+            server.spec.get("slo") or {},
+            FLEET.server_summary(server.namespace, server.name),
+            ko.is_condition_true(server.obj, cond.SLO_VIOLATED),
+            FLEET.scrape_age(key), 2.0 * interval, base)
+        if action is not None:
+            print(f"autoscale: servers/{server.name} -> {desired} "
+                  f"({action['direction']}: {action['reason']})",
+                  flush=True)
+            REGISTRY.inc(
+                "controller_autoscale_actions_total",
+                server=server.name, namespace=server.namespace,
+                direction=action["direction"],
+                help_text="Autoscaler replica-count changes, by server "
+                          "and direction.")
+        mn = max(1, int(spec.get("minReplicas", 1)))
+        status = autoscale_mod.status_block(
+            (server.namespace, server.name), mn,
+            int(spec.get("maxReplicas", mn)))
+        changed = server.status.get("autoscale") != status
+        if changed:
+            server.status["autoscale"] = status
+        return desired, changed
 
     # ------------------------------------------------------------------
 
@@ -204,7 +339,82 @@ class ServerReconciler:
             },
         }
 
-    def _deployment(self, ctx: Ctx, server: Server, model) -> dict:
+    def _gateway_service(self, server: Server) -> dict:
+        """Client-facing Service for the routing data plane: port 80 ->
+        the gateway pods. The replica Service stays (the gateway and the
+        fleet scraper address pods directly), but with spec.gateway
+        enabled this is the ingress clients should use
+        (docs/serving-dataplane.md)."""
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"{server.name}-gateway",
+                         "namespace": server.namespace},
+            "spec": {
+                "selector": {"server": server.name, "role": "gateway"},
+                "ports": [{"name": "http-gateway", "port": 80,
+                           "targetPort": GATEWAY_PORT, "protocol": "TCP"}],
+            },
+        }
+
+    def _gateway_deployment(self, server: Server, gateway: dict) -> dict:
+        """The gateway Deployment (serve/gateway.py): same image as the
+        serve container, CPU-only, discovers replica pods via the k8s API
+        (RBT_GATEWAY_SERVER/NAMESPACE). Stateless — scale it with
+        spec.gateway.replicas for HA; the consistent-hash affinity ring
+        is stable across gateway replicas (SHA-1 points, no shared
+        state)."""
+        container = {
+            "name": "gateway",
+            "image": server.image,
+            "command": ["python", "-m", "runbooks_tpu.serve.gateway"],
+            "env": resolve_env(server.env) + [
+                {"name": "RBT_GATEWAY_SERVER", "value": server.name},
+                {"name": "RBT_GATEWAY_NAMESPACE",
+                 "value": server.namespace},
+                {"name": "RBT_GATEWAY_POLICY",
+                 "value": str(gateway.get("policy", "prefix"))},
+                {"name": "RBT_GATEWAY_BLOCK_CHARS",
+                 "value": str(gateway.get("blockChars", 64))},
+                {"name": "RBT_GATEWAY_AFFINITY",
+                 "value": "0" if gateway.get("sessionAffinity") is False
+                 else "1"},
+            ],
+            "ports": [{"name": "http-gateway",
+                       "containerPort": GATEWAY_PORT}],
+            # Readiness = "can route somewhere": the gateway 503s its
+            # probe while zero backends are healthy, so the Service only
+            # sends traffic to gateways that can place it.
+            "readinessProbe": {
+                "httpGet": {"path": "/", "port": GATEWAY_PORT},
+                "periodSeconds": 5,
+                "initialDelaySeconds": 2,
+            },
+        }
+        pod_spec = {
+            "serviceAccountName": SA_MODEL_SERVER,
+            "containers": [container],
+        }
+        mount_params(pod_spec, "gateway", server)
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": f"{server.name}-gateway",
+                         "namespace": server.namespace},
+            "spec": {
+                "replicas": int(gateway.get("replicas", 1)),
+                "selector": {"matchLabels": {"server": server.name,
+                                             "role": "gateway"}},
+                "template": {
+                    "metadata": {"labels": {"server": server.name,
+                                            "role": "gateway"}},
+                    "spec": pod_spec,
+                },
+            },
+        }
+
+    def _deployment(self, ctx: Ctx, server: Server, model,
+                    replicas: Optional[int] = None) -> dict:
         tpu = parse_tpu(server.tpu) if server.tpu else None
         container = {
             "name": "serve",
@@ -241,7 +451,8 @@ class ServerReconciler:
             "kind": "Deployment",
             "metadata": {"name": server.name, "namespace": server.namespace},
             "spec": {
-                "replicas": server.spec.get("replicas", 1),
+                "replicas": (int(replicas) if replicas is not None
+                             else server.spec.get("replicas", 1)),
                 "selector": {"matchLabels": {"server": server.name,
                                              "role": "run"}},
                 "template": {"metadata": pod_meta, "spec": pod_spec},

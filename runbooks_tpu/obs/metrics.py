@@ -52,6 +52,7 @@ CATALOG: Dict[str, str] = {
     "controller_apiserver_errors_total": "counter",
     "controller_slice_restarts_total": "counter",
     "controller_slo_violations_total": "counter",
+    "controller_autoscale_actions_total": "counter",
     "controller_fleet_scrape_seconds": "histogram",
     # fleet scraper (per-replica labels {kind, name, replica}; the serve_*
     # and train_* families below also appear with these labels on the
@@ -113,6 +114,16 @@ CATALOG: Dict[str, str] = {
     "serve_kv_pages_used": "gauge",
     "serve_kv_pages_shared": "gauge",
     "serve_prefix_pages_reused_total": "counter",
+    # Serving gateway (serve/gateway.py, docs/serving-dataplane.md):
+    # the multi-replica routing data plane
+    "gateway_requests_total": "counter",
+    "gateway_route_decisions_total": "counter",
+    "gateway_retries_total": "counter",
+    "gateway_affinity_requests_total": "counter",
+    "gateway_affinity_hits_total": "counter",
+    "gateway_proxy_latency_seconds": "histogram",
+    "gateway_replicas_healthy": "gauge",
+    "gateway_shadow_blocks": "gauge",
     # process
     "process_uptime_seconds": "gauge",
 }

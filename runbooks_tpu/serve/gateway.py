@@ -1,0 +1,810 @@
+"""Prefix-aware serving gateway: N replicas acting like one big engine.
+
+The Server CRD has had ``replicas`` since the seed, but load balancing
+across them was whatever the k8s Service did — random spraying, which
+destroys exactly the KV-cache locality the paged engine's radix tree
+(serve/paging.py) builds up. This module is the data plane in front of a
+Server's replica pods: a thin, stateless aiohttp proxy (same stack as
+serve/api.py) that routes every ``/v1/*`` request by
+
+- **longest expected prefix-cache match**: the gateway keeps a per-replica
+  *shadow radix index* over the routing keys of recently routed prompts —
+  an estimate of what each replica's real prefix cache holds. The shadow
+  is refreshed against each replica's scraped ``serve_prefix_*`` /
+  ``serve_kv_pages_*`` metrics, so it tracks real eviction (shadow capped
+  to the replica's live shared-page count) and replica restarts (counter
+  reset clears the shadow). Routing keys are fixed-size blocks: token-id
+  pages when the caller supplies token ids (the in-process router used by
+  bench_serve), fixed-width character blocks of the prompt text on the
+  HTTP path — identical text prefixes tokenize to identical token-id
+  prefixes, which is the only property prefix matching needs.
+- **live load**: queue depth, active slots, and queue-wait p90 scraped
+  from each replica's ``/metrics`` (the PR-5/PR-6 exposition), plus the
+  gateway's own in-flight count per replica, break prefix ties and route
+  cold prompts to the least-loaded replica.
+- **session affinity**: a consistent-hash ring (stable SHA-1 points, so
+  every gateway replica agrees) pins multi-turn chat sessions
+  (``X-Session-Id`` header or OpenAI ``user`` field) to one replica;
+  removing an unrelated replica does not remap a session.
+- **deadline-aware failover**: a pick that answers 429/503 (or is
+  unreachable) retries on the next-ranked replica with the request's
+  REMAINING deadline budget — the forwarded ``timeout`` field shrinks by
+  the time already burned, so the end-to-end deadline the client asked
+  for is preserved across hops.
+
+The controller deploys this gateway (Deployment + Service) alongside the
+replicas when ``Server.spec.gateway.enabled`` and feeds the companion
+autoscaler from the same fleet telemetry (controller/autoscale.py,
+docs/serving-dataplane.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+import urllib.request
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from runbooks_tpu.obs import metrics as obs_metrics
+
+GATEWAY_PORT = 8080
+
+# Character width of one routing-key block on the HTTP path. ~4 chars per
+# token means 64 chars ~ one 16-token KV page — the granularity the paged
+# engine shares at. Coarser blocks under-count matches; finer ones make
+# the shadow index bigger for no routing benefit.
+DEFAULT_BLOCK_CHARS = 64
+
+# Longest prompt prefix the gateway keys on, in blocks. Locality lives in
+# system prompts / templates at the front; keying deeper just grows the
+# shadow.
+MAX_KEY_BLOCKS = 64
+
+# Default per-replica shadow cap when the replica exports no page gauges
+# (dense engines): bounded memory, LRU keeps the hot prefixes.
+DEFAULT_SHADOW_BLOCKS = 4096
+
+DEFAULT_SCRAPE_INTERVAL_S = 2.0
+
+# Queue depth at which a replica forfeits its prefix preference: re-prefilling
+# a shared prefix elsewhere is cheaper than queueing behind this much work.
+PREFIX_SPILL_QUEUE = 8
+
+
+def text_blocks(text: str, block_chars: int = DEFAULT_BLOCK_CHARS,
+                max_blocks: int = MAX_KEY_BLOCKS) -> List[str]:
+    """Routing-key blocks for a prompt string (see module docstring)."""
+    return [text[i * block_chars:(i + 1) * block_chars]
+            for i in range(min(len(text) // block_chars, max_blocks))]
+
+
+def token_blocks(tokens: Sequence[int], block_tokens: int = 16,
+                 max_blocks: int = MAX_KEY_BLOCKS) -> List[tuple]:
+    """Routing-key blocks over token ids (in-process router callers),
+    at KV-page granularity so the shadow mirrors the engine's radix."""
+    return [tuple(int(t) for t in
+                  tokens[i * block_tokens:(i + 1) * block_tokens])
+            for i in range(min(len(tokens) // block_tokens, max_blocks))]
+
+
+class ShadowIndex:
+    """Trie over routing-key blocks: the gateway's estimate of one
+    replica's prefix-cache content. Same shape as the engine's RadixTree
+    (serve/paging.py) minus the page ownership — nodes are blocks, LRU
+    recency on match/record, trim() evicts LRU leaves when the replica's
+    scraped shared-page count says the real cache shrank. All access goes
+    through the owning Router's lock."""
+
+    class _Node:
+        __slots__ = ("children", "parent", "edge", "last_used")
+
+        def __init__(self, parent=None, edge=None):
+            self.children: dict = {}
+            self.parent = parent
+            self.edge = edge
+            self.last_used = 0
+
+    def __init__(self, max_blocks: int = DEFAULT_SHADOW_BLOCKS):
+        self.max_blocks = max_blocks
+        self.root = self._Node()
+        self.blocks = 0
+        self._clock = 0
+
+    def match(self, blocks: Sequence) -> int:
+        """Leading blocks present in the shadow (the expected prefix-cache
+        hit length, in blocks). Refreshes recency on the matched path."""
+        self._clock += 1
+        node, n = self.root, 0
+        for b in blocks:
+            child = node.children.get(b)
+            if child is None:
+                break
+            child.last_used = self._clock
+            node, n = child, n + 1
+        return n
+
+    def record(self, blocks: Sequence) -> None:
+        """Mark the prefix as (expected) resident on the replica."""
+        self._clock += 1
+        node = self.root
+        for b in blocks:
+            child = node.children.get(b)
+            if child is None:
+                child = self._Node(parent=node, edge=b)
+                node.children[b] = child
+                self.blocks += 1
+            child.last_used = self._clock
+            node = child
+        if self.blocks > self.max_blocks:
+            self.trim(self.max_blocks)
+
+    def trim(self, cap: int) -> int:
+        """Evict LRU leaves until at most ``cap`` blocks remain (the
+        replica's scraped shared-page count shrank — its radix evicted,
+        so the shadow must forget too). Returns blocks dropped."""
+        dropped = 0
+        while self.blocks > max(cap, 0):
+            leaves = []
+            stack = [self.root]
+            while stack:
+                n = stack.pop()
+                for c in n.children.values():
+                    (stack if c.children else leaves).append(c)
+            if not leaves:
+                break
+            leaves.sort(key=lambda n: n.last_used)
+            for leaf in leaves[:self.blocks - max(cap, 0)]:
+                del leaf.parent.children[leaf.edge]
+                self.blocks -= 1
+                dropped += 1
+        return dropped
+
+    def clear(self) -> None:
+        self.root = self._Node()
+        self.blocks = 0
+
+
+class _HashRing:
+    """Consistent-hash ring with stable (SHA-1) points: every gateway
+    replica computes the same session->replica mapping, and removing one
+    replica only remaps the sessions it owned."""
+
+    def __init__(self, names: Iterable[str], vnodes: int = 64):
+        self._points: List[Tuple[int, str]] = []
+        for name in names:
+            for i in range(vnodes):
+                self._points.append((self._hash(f"{name}#{i}"), name))
+        self._points.sort()
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(key.encode("utf-8")).digest()[:8], "big")
+
+    def owner(self, key: str) -> Optional[str]:
+        if not self._points:
+            return None
+        h = self._hash(key)
+        i = bisect_left(self._points, (h, ""))
+        return self._points[i % len(self._points)][1]
+
+
+class ReplicaState:
+    """One backend replica as the gateway sees it."""
+
+    __slots__ = ("name", "url", "healthy", "active_slots", "queue_depth",
+                 "queue_wait_p90_ms", "inflight", "shadow",
+                 "requests_total", "shared_pages")
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.healthy = True   # optimistic until a scrape/proxy says otherwise
+        self.active_slots = 0.0
+        self.queue_depth = 0.0
+        self.queue_wait_p90_ms = 0.0
+        self.inflight = 0
+        self.shadow = ShadowIndex()
+        self.requests_total: Optional[float] = None
+        self.shared_pages: Optional[int] = None
+
+
+class Router:
+    """Routing brain shared by the HTTP gateway and in-process callers.
+
+    Thread-safety: the metrics poller (a plain thread) and the event-loop
+    handlers both touch the replica table, so every access to
+    ``_replicas``/``_ring`` holds ``_lock`` — critical sections are
+    short (no I/O under the lock)."""
+
+    def __init__(self, targets: Optional[Dict[str, str]] = None,
+                 policy: str = "prefix",
+                 registry: Optional[obs_metrics.Registry] = None,
+                 shadow_blocks: int = DEFAULT_SHADOW_BLOCKS,
+                 session_affinity: bool = True,
+                 spill_queue: int = PREFIX_SPILL_QUEUE):
+        if policy not in ("prefix", "random"):
+            raise ValueError(f"unknown routing policy {policy!r} "
+                             "(expected prefix|random)")
+        self.policy = policy
+        self.registry = registry if registry is not None else \
+            obs_metrics.Registry()
+        self.session_affinity = session_affinity
+        self.spill_queue = spill_queue
+        self.shadow_blocks = shadow_blocks
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaState] = {}   # guarded-by: _lock
+        self._ring = _HashRing(())                     # guarded-by: _lock
+        self._rng = random.Random(0)                   # guarded-by: _lock
+        if targets:
+            self.set_replicas(targets)
+
+    # -- replica set ---------------------------------------------------
+
+    def set_replicas(self, targets: Dict[str, str]) -> None:
+        """Reconcile the backend set: new names join with an empty shadow,
+        vanished names drop (their mirrored gauges too). Surviving
+        replicas keep their shadow — a scale event must not blind the
+        router to every cache it already mapped."""
+        with self._lock:
+            for name, url in targets.items():
+                if name not in self._replicas:
+                    self._replicas[name] = ReplicaState(name, url)
+                    self._replicas[name].shadow.max_blocks = \
+                        self.shadow_blocks
+                else:
+                    self._replicas[name].url = url.rstrip("/")
+            for name in [n for n in self._replicas if n not in targets]:
+                del self._replicas[name]
+                self.registry.drop_series(backend=name)
+            self._ring = _HashRing(sorted(self._replicas))
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if r.healthy)
+
+    # -- telemetry in --------------------------------------------------
+
+    def observe_metrics(self, name: str,
+                        families: Optional[dict]) -> None:
+        """Fold one scrape of a replica's /metrics into the routing state.
+        ``families`` is a parse_exposition() dict, or None when the scrape
+        failed (marks the replica unhealthy). The shadow refresh is where
+        the gateway's picture tracks REAL cache state: a shrinking
+        ``serve_kv_pages_shared`` trims the shadow to match, a
+        ``serve_requests_total`` reset (replica restart) clears it."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return
+            if families is None:
+                rep.healthy = False
+                return
+            rep.healthy = True
+
+            def val(fam: str, default=None):
+                f = families.get(fam)
+                return f.total() if f is not None and f.samples else default
+
+            rep.active_slots = float(val("serve_active_slots", 0.0))
+            rep.queue_depth = float(val("serve_queue_depth", 0.0))
+            qw = families.get("serve_queue_wait_seconds")
+            hist = qw.merged_histogram() if qw is not None else None
+            if hist is not None and hist.count:
+                rep.queue_wait_p90_ms = hist.quantile(0.90) * 1000.0
+            total = val("serve_requests_total")
+            if total is not None:
+                if rep.requests_total is not None \
+                        and total < rep.requests_total:
+                    # Counter reset = replica restarted = caches gone.
+                    rep.shadow.clear()
+                rep.requests_total = total
+            shared = val("serve_kv_pages_shared")
+            if shared is not None:
+                rep.shared_pages = int(shared)
+                if rep.shadow.blocks > rep.shared_pages:
+                    # The replica's radix evicted below what we routed;
+                    # forget the same amount (LRU both sides).
+                    rep.shadow.trim(rep.shared_pages)
+
+    def mark_unreachable(self, name: str) -> None:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.healthy = False
+
+    # -- routing -------------------------------------------------------
+
+    def _load(self, rep: ReplicaState) -> float:
+        # Inflight counts twice: it is load the scrape hasn't seen yet.
+        return rep.active_slots + rep.queue_depth + 2.0 * rep.inflight
+
+    def pick(self, blocks: Sequence, session_key: Optional[str] = None,
+             ) -> List[Tuple[str, str]]:
+        """Ranked (replica_name, reason) candidates for one request.
+        Reason of the head pick: ``affinity`` (session ring owner),
+        ``prefix`` (longest shadow match won), ``load`` (no prefix signal
+        — least loaded), or ``random`` (policy=random). Later entries are
+        the failover order (reason ``failover``)."""
+        with self._lock:
+            healthy = [r for r in self._replicas.values() if r.healthy]
+            if not healthy:
+                return []
+            if self.policy == "random":
+                order = list(healthy)
+                self._rng.shuffle(order)
+                return [(r.name, "random" if i == 0 else "failover")
+                        for i, r in enumerate(order)]
+            match = {r.name: r.shadow.match(blocks) for r in healthy}
+            # Deep queues forfeit prefix preference: past spill_queue the
+            # queue wait dominates what the prefix hit would save.
+            score = {r.name: (match[r.name]
+                              if r.queue_depth < self.spill_queue else 0)
+                     for r in healthy}
+            ranked = sorted(
+                healthy,
+                key=lambda r: (-score[r.name], self._load(r),
+                               r.queue_wait_p90_ms,
+                               _HashRing._hash(r.name)))
+            head_reason = ("prefix" if score[ranked[0].name] > 0
+                           else "load")
+            out = [(r.name, "failover") for r in ranked]
+            out[0] = (ranked[0].name, head_reason)
+            if self.session_affinity and session_key:
+                owner = self._ring.owner(session_key)
+                if owner is not None and owner in match \
+                        and self._replicas[owner].healthy:
+                    rest = [(n, "failover") for n, _ in out if n != owner]
+                    return [(owner, "affinity")] + rest
+            return out
+
+    def record_route(self, name: str, blocks: Sequence) -> None:
+        """Commit a successful route into the replica's shadow (the
+        replica now holds — or is about to hold — this prefix)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None and blocks:
+                rep.shadow.record(blocks)
+
+    def inflight_add(self, name: str, delta: int) -> None:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.inflight = max(0, rep.inflight + delta)
+
+    # -- telemetry out -------------------------------------------------
+
+    def export_gauges(self) -> None:
+        """Scrape-time gauges on the gateway's registry."""
+        with self._lock:
+            self.registry.set_gauge(
+                "gateway_replicas_healthy", self.healthy_count_locked(),
+                help_text="Backend replicas the gateway currently "
+                          "considers routable.")
+            # Per-backend series label on the gateway's own exposition
+            # is `backend`, NOT `replica`: the fleet scraper mirrors
+            # these families with replica=<gateway pod> (the scraped
+            # pod's identity wins on collision), so a replica-named
+            # label here would collapse every backend onto one series
+            # in the controller mirror.
+            for rep in self._replicas.values():
+                self.registry.set_gauge(
+                    "gateway_shadow_blocks", rep.shadow.blocks,
+                    backend=rep.name,
+                    help_text="Routing-key blocks in the per-backend "
+                              "shadow prefix index.")
+
+    def healthy_count_locked(self) -> int:  # guarded-by: _lock
+        return sum(1 for r in self._replicas.values() if r.healthy)
+
+
+class MetricsPoller:
+    """Background thread scraping every replica's /metrics into the
+    Router (the same degradation contract as the controller's fleet
+    scraper: one unreachable replica marks itself down, never the
+    sweep). ``poll_once`` is synchronous for tests and tools."""
+
+    def __init__(self, router: Router, timeout_s: float = 2.0,
+                 discover=None):
+        self.router = router
+        self.timeout_s = timeout_s
+        # Optional replica discovery hook: () -> {name: url}; polled
+        # every sweep so a scale event updates the backend set without a
+        # gateway restart (the k8s main() wires pod listing here).
+        self.discover = discover
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> int:
+        if self.discover is not None:
+            try:
+                targets = self.discover()
+            except Exception as exc:  # noqa: BLE001 — discovery outage
+                print(f"gateway: replica discovery failed: {exc!r}",
+                      flush=True)
+                targets = None
+            if targets is not None:
+                self.router.set_replicas(targets)
+        ok = 0
+        with self.router._lock:
+            urls = {r.name: r.url for r in self.router._replicas.values()}
+        for name, url in urls.items():
+            families = None
+            try:
+                with urllib.request.urlopen(f"{url}/metrics",
+                                            timeout=self.timeout_s) as resp:
+                    families = obs_metrics.parse_exposition(
+                        resp.read().decode("utf-8", "replace"))
+                ok += 1
+            except (OSError, ValueError):
+                families = None
+            self.router.observe_metrics(name, families)
+        return ok
+
+    def start(self, interval_s: float = DEFAULT_SCRAPE_INTERVAL_S) -> None:
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    pass  # per-replica errors are already contained; this
+                    # catch only guards discovery/bookkeeping bugs from
+                    # killing the data plane's telemetry loop
+                self._stop.wait(interval_s)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# HTTP gateway
+# ---------------------------------------------------------------------------
+
+def _render_chat_prompt(messages: list) -> str:
+    """The same role-prefix rendering serve/api.py falls back to — the
+    routing key must track what the replica will actually prefill."""
+    parts = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+             for m in messages if isinstance(m, dict)]
+    return "\n".join(parts) + "\nassistant:"
+
+
+def create_gateway(targets: Optional[Dict[str, str]] = None, *,
+                   policy: str = "prefix",
+                   block_chars: int = DEFAULT_BLOCK_CHARS,
+                   session_affinity: bool = True,
+                   request_timeout_s: Optional[float] = None,
+                   scrape_interval_s: float = DEFAULT_SCRAPE_INTERVAL_S,
+                   discover=None,
+                   registry: Optional[obs_metrics.Registry] = None):
+    """The gateway aiohttp Application.
+
+    targets: initial {replica_name: base_url}; discover (optional) is
+    polled by the metrics loop to refresh the set (k8s pod listing).
+    request_timeout_s: default end-to-end deadline for requests that
+    carry none of their own; per-request ``timeout`` overrides, and the
+    remaining budget rides every failover hop."""
+    from aiohttp import ClientError, ClientSession, ClientTimeout, web
+
+    router = Router(targets, policy=policy, registry=registry,
+                    session_affinity=session_affinity)
+    poller = MetricsPoller(router, discover=discover)
+    app = web.Application()
+    app["router"] = router
+    app["poller"] = poller
+    reg = router.registry
+    started = time.time()
+
+    async def client_session(app_):
+        app_["client"] = ClientSession()
+        if scrape_interval_s > 0:
+            poller.start(scrape_interval_s)
+        yield
+        poller.stop()
+        await app_["client"].close()
+
+    app.cleanup_ctx.append(client_session)
+
+    def _session_key(request, body: dict) -> Optional[str]:
+        sid = request.headers.get("X-Session-Id") or body.get("user")
+        return str(sid) if sid else None
+
+    def _blocks_for(body: dict, chat: bool) -> list:
+        if chat:
+            prompt = _render_chat_prompt(body.get("messages") or [])
+        else:
+            prompt = body.get("prompt")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt and isinstance(prompt[0], str) \
+                    else ""
+            if not isinstance(prompt, str):
+                prompt = ""
+        return text_blocks(prompt, block_chars)
+
+    async def _proxy(request, chat: bool):
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400)
+        blocks = _blocks_for(body, chat)
+        session_key = _session_key(request, body)
+        reg.inc("gateway_requests_total",
+                help_text="Requests accepted by the gateway.")
+        if session_key:
+            reg.inc("gateway_affinity_requests_total",
+                    help_text="Requests carrying a session key "
+                              "(X-Session-Id or user).")
+        candidates = router.pick(blocks, session_key)
+        if not candidates:
+            return web.json_response(
+                {"error": {"message": "no healthy replica",
+                           "type": "unavailable"}},
+                status=503, headers={"Retry-After": "5"})
+
+        # Deadline budget: explicit body timeout wins, else the
+        # gateway-level default. Each hop forwards only what remains.
+        try:
+            budget = (float(body["timeout"]) if body.get("timeout")
+                      is not None else request_timeout_s)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "malformed timeout"}}, status=400)
+        t0 = time.monotonic()
+        deadline = t0 + budget if budget else None
+
+        last_status, last_body = 503, {"error": {
+            "message": "every replica rejected the request",
+            "type": "overloaded"}}
+        for i, (name, reason) in enumerate(candidates):
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0.05:
+                    return web.json_response(
+                        {"error": {"message": "deadline exhausted before "
+                                              "a replica accepted",
+                                   "type": "deadline"}}, status=504)
+                body["timeout"] = round(remaining, 3)
+            with router._lock:
+                rep = router._replicas.get(name)
+                url = rep.url if rep is not None else None
+            if url is None:
+                continue
+            reg.inc("gateway_route_decisions_total", reason=reason,
+                    backend=name,
+                    help_text="Routing decisions, labeled by the reason "
+                              "the replica was picked.")
+            if reason == "affinity":
+                reg.inc("gateway_affinity_hits_total",
+                        help_text="Requests actually routed to their "
+                                  "session ring owner.")
+            router.inflight_add(name, 1)
+            t_hop = time.perf_counter()
+            try:
+                timeout = ClientTimeout(total=remaining if remaining
+                                        else 600)
+                resp = await app["client"].post(
+                    url + request.path, json=body, timeout=timeout)
+            except (ClientError, asyncio.TimeoutError) as exc:
+                router.inflight_add(name, -1)
+                router.mark_unreachable(name)
+                reg.inc("gateway_retries_total", reason="unreachable",
+                        help_text="Failovers to the next-ranked replica, "
+                                  "by cause.")
+                last_status, last_body = 502, {"error": {
+                    "message": f"replica {name} unreachable: {exc}",
+                    "type": "unreachable"}}
+                continue
+            try:
+                if resp.status in (429, 503) and i + 1 < len(candidates):
+                    # Typed backpressure (serve/api.py): this replica is
+                    # full or draining — the next one may not be.
+                    last_status = resp.status
+                    try:
+                        last_body = await resp.json()
+                    except Exception:  # noqa: BLE001 — non-JSON error body
+                        last_body = {"error": {"message": "overloaded"}}
+                    reg.inc("gateway_retries_total",
+                            reason="overloaded" if resp.status == 429
+                            else "draining")
+                    continue
+                if resp.status < 400:
+                    # Only a served request proves the prefix landed in
+                    # the replica's cache; errors must not poison the
+                    # shadow.
+                    router.record_route(name, blocks)
+                ctype = resp.headers.get("Content-Type", "")
+                headers = {"X-Gateway-Replica": name}
+                for h in ("X-Request-Id", "traceparent", "Retry-After"):
+                    if h in resp.headers:
+                        headers[h] = resp.headers[h]
+                if ctype.startswith("text/event-stream"):
+                    out = web.StreamResponse(
+                        status=resp.status,
+                        headers={"Content-Type": ctype,
+                                 "Cache-Control": "no-cache", **headers})
+                    await out.prepare(request)
+                    async for chunk in resp.content.iter_any():
+                        await out.write(chunk)
+                    await out.write_eof()
+                else:
+                    payload = await resp.read()
+                    out = web.Response(
+                        body=payload, status=resp.status,
+                        content_type=ctype.split(";")[0] or
+                        "application/json", headers=headers)
+                reg.observe(
+                    "gateway_proxy_latency_seconds",
+                    time.perf_counter() - t_hop, backend=name,
+                    help_text="Wall time of the proxied replica call, "
+                              "per backend.")
+                return out
+            finally:
+                resp.release()
+                router.inflight_add(name, -1)
+        return web.json_response(
+            last_body, status=last_status,
+            headers={"Retry-After": "2"} if last_status in (429, 503)
+            else {})
+
+    async def completions(request):
+        return await _proxy(request, chat=False)
+
+    async def chat_completions(request):
+        return await _proxy(request, chat=True)
+
+    async def register_prefix(request):
+        """Broadcast /v1/prefix to every healthy replica: a registered
+        deployment prefix must be resident everywhere or routing away
+        from its seed replica loses it. Shadows record it for all."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400)
+        blocks = (text_blocks(body["prompt"], block_chars)
+                  if isinstance(body.get("prompt"), str) else
+                  token_blocks(body["tokens"])
+                  if isinstance(body.get("tokens"), list) else [])
+        with router._lock:
+            targets_now = [(r.name, r.url) for r in
+                           router._replicas.values() if r.healthy]
+        if not targets_now:
+            return web.json_response(
+                {"error": {"message": "no healthy replica"}}, status=503)
+
+        async def one(name, url):
+            try:
+                resp = await app["client"].post(
+                    url + "/v1/prefix", json=body,
+                    timeout=ClientTimeout(total=600))
+                try:
+                    if resp.status == 200:
+                        data = await resp.json()
+                        router.record_route(name, blocks)
+                        return int(data.get("cached_prefix_len", 0))
+                finally:
+                    resp.release()
+            except (ClientError, asyncio.TimeoutError):
+                router.mark_unreachable(name)
+            return 0
+
+        plens = await asyncio.gather(*(one(n, u) for n, u in targets_now))
+        return web.json_response({"cached_prefix_len": max(plens),
+                                  "replicas": len(plens)})
+
+    async def root(request):
+        """Readiness: the gateway is ready only while it can route
+        somewhere — a gateway with zero healthy backends must fail its
+        probe (the Serving gate counts on it; controller/server.py)."""
+        healthy = router.healthy_count()
+        status = 200 if healthy else 503
+        return web.json_response(
+            {"status": "ok" if healthy else "no healthy replica",
+             "gateway": True, "replicas_healthy": healthy,
+             "policy": router.policy,
+             "uptime_s": round(time.time() - started, 1)},
+            status=status)
+
+    async def healthz(request):
+        return web.json_response({"ok": True})
+
+    async def metrics(request):
+        router.export_gauges()
+        return web.Response(body=reg.render().encode("utf-8"),
+                            headers={"Content-Type":
+                                     obs_metrics.CONTENT_TYPE})
+
+    app.router.add_get("/", root)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/prefix", register_prefix)
+    return app
+
+
+# ---------------------------------------------------------------------------
+# Container entrypoint (the controller's gateway Deployment runs this)
+# ---------------------------------------------------------------------------
+
+def k8s_discover(client, namespace: str, server: str, port: int = 8080):
+    """() -> {pod_name: url} over the Server's running replica pods —
+    the same labels the fleet scraper discovers by (server=<n>, role=run),
+    skipping pods already marked for deletion (a scale-in's terminating
+    pods must leave the routing set immediately)."""
+    from runbooks_tpu.k8s import objects as ko
+
+    def discover():
+        out = {}
+        for pod in client.list("v1", "Pod", namespace=namespace,
+                               label_selector={"server": server,
+                                               "role": "run"}):
+            if ko.deep_get(pod, "metadata", "deletionTimestamp",
+                           default=None):
+                continue
+            ip = ko.deep_get(pod, "status", "podIP")
+            phase = ko.deep_get(pod, "status", "phase", default="")
+            if ip and phase == "Running":
+                out[ko.name(pod)] = f"http://{ip}:{port}"
+        return out
+
+    return discover
+
+
+def main() -> int:
+    from aiohttp import web
+
+    from runbooks_tpu.utils import contract
+
+    params = contract.load_params()
+    server = os.environ.get("RBT_GATEWAY_SERVER", "")
+    namespace = os.environ.get("RBT_GATEWAY_NAMESPACE", "default")
+    targets_env = os.environ.get("RBT_GATEWAY_TARGETS", "")
+    targets = {}
+    for i, part in enumerate(p for p in targets_env.split(",") if p):
+        name, _, url = part.rpartition("=")
+        targets[name or f"replica-{i}"] = url
+    discover = None
+    if server and not targets:
+        from runbooks_tpu.k8s.client import K8sClient, KubeConfig
+
+        discover = k8s_discover(K8sClient(KubeConfig.auto()), namespace,
+                                server)
+    # Gateway knobs arrive as env injected by the Server reconciler
+    # (spec.gateway is not part of spec.params, so it is not in
+    # params.json); params.json still supplies the server-wide
+    # request_timeout_s the gateway inherits as its deadline default.
+    app = create_gateway(
+        targets or None,
+        policy=os.environ.get("RBT_GATEWAY_POLICY", "prefix"),
+        block_chars=int(os.environ.get("RBT_GATEWAY_BLOCK_CHARS",
+                                       str(DEFAULT_BLOCK_CHARS))),
+        session_affinity=os.environ.get("RBT_GATEWAY_AFFINITY", "1")
+        not in ("0", "false"),
+        request_timeout_s=(float(params["request_timeout_s"])
+                           if isinstance(params, dict)
+                           and params.get("request_timeout_s") else None),
+        discover=discover)
+    port = int(os.environ.get("RBT_GATEWAY_PORT", GATEWAY_PORT))
+    web.run_app(app, port=port, print=lambda *a: None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,944 @@
+"""Multi-replica serving data plane tests (ISSUE 10).
+
+Covers: prefix-aware routing (longest shadow match beats least-loaded;
+load breaks ties; deep queues spill), consistent-hash session affinity
+surviving replica-set changes, deadline-aware 429/503 failover through
+the real HTTP proxy, the shadow index tracking scraped eviction/restart,
+the controller-driven autoscaler (1→N on sustained queue wait, N→min on
+idle, cooldown and scrape-staleness holds), the serving-gate fixes
+(gateway-ready requirement; scale-in transitions), spec validation, the
+fleet-state retain fix, and zero unexpected XLA compiles on replicas
+under routed traffic.
+"""
+
+import asyncio
+import dataclasses
+import json
+import time
+
+import pytest
+
+from runbooks_tpu.api import conditions as cond
+from runbooks_tpu.api.types import API_VERSION, Model, Server
+from runbooks_tpu.cloud.base import CommonConfig
+from runbooks_tpu.cloud.local import LocalCloud
+from runbooks_tpu.controller import autoscale as autoscale_mod
+from runbooks_tpu.controller import fleet as fl
+from runbooks_tpu.controller.common import (
+    validate_autoscale,
+    validate_gateway,
+)
+from runbooks_tpu.controller.manager import Ctx, Manager
+from runbooks_tpu.controller.model import ModelReconciler
+from runbooks_tpu.controller.server import ServerReconciler
+from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.k8s.fake import FakeCluster
+from runbooks_tpu.obs import metrics as obs_metrics
+from runbooks_tpu.sci.base import FakeSCI
+from runbooks_tpu.serve.gateway import (
+    MetricsPoller,
+    Router,
+    ShadowIndex,
+    create_gateway,
+    text_blocks,
+    token_blocks,
+)
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::DeprecationWarning")
+
+
+# ---------------------------------------------------------------------------
+# Router unit tests (no HTTP)
+# ---------------------------------------------------------------------------
+
+def fams(**values):
+    """A parse_exposition-shaped dict from plain gauges/counters."""
+    out = {}
+    for name, v in values.items():
+        fam = obs_metrics.ParsedFamily(name, "gauge")
+        fam.samples[()] = float(v)
+        out[name] = fam
+    return out
+
+
+def test_prefix_match_beats_least_loaded():
+    r = Router({"a": "http://a", "b": "http://b"})
+    blocks = text_blocks("x" * 640)
+    # b is idle, a holds the prefix but carries more load.
+    r.record_route("a", blocks)
+    r.observe_metrics("a", fams(serve_active_slots=3, serve_queue_depth=2))
+    r.observe_metrics("b", fams(serve_active_slots=0, serve_queue_depth=0))
+    picks = r.pick(blocks)
+    assert picks[0] == ("a", "prefix")
+    assert picks[1] == ("b", "failover")
+
+
+def test_load_breaks_prefix_ties():
+    r = Router({"a": "http://a", "b": "http://b", "c": "http://c"})
+    blocks = text_blocks("y" * 640)
+    # No shadow entries anywhere: pure load routing.
+    r.observe_metrics("a", fams(serve_active_slots=4, serve_queue_depth=0))
+    r.observe_metrics("b", fams(serve_active_slots=1, serve_queue_depth=0))
+    r.observe_metrics("c", fams(serve_active_slots=2, serve_queue_depth=3))
+    name, reason = r.pick(blocks)[0]
+    assert (name, reason) == ("b", "load")
+    # Equal prefix on two replicas: the less-loaded one (a: load 4 vs
+    # c: load 5) wins the tie.
+    r.record_route("a", blocks)
+    r.record_route("c", blocks)
+    name, reason = r.pick(blocks)[0]
+    assert (name, reason) == ("a", "prefix")
+
+
+def test_deep_queue_forfeits_prefix_preference():
+    r = Router({"a": "http://a", "b": "http://b"})
+    blocks = text_blocks("z" * 640)
+    r.record_route("a", blocks)
+    # a's queue is past the spill threshold: re-prefilling on idle b is
+    # cheaper than queueing behind 20 requests.
+    r.observe_metrics("a", fams(serve_active_slots=8,
+                                serve_queue_depth=20))
+    r.observe_metrics("b", fams(serve_active_slots=0,
+                                serve_queue_depth=0))
+    name, reason = r.pick(blocks)[0]
+    assert name == "b" and reason == "load"
+
+
+def test_session_affinity_survives_replica_set_changes():
+    r = Router({f"r{i}": f"http://r{i}" for i in range(4)})
+    blocks = text_blocks("w" * 640)
+    owner = r.pick(blocks, session_key="sess-42")[0]
+    assert owner[1] == "affinity"
+    # Removing an UNRELATED replica must not remap the session
+    # (consistent hashing: only the removed replica's sessions move).
+    survivors = {n: f"http://{n}" for n in r.replica_names()
+                 if n != owner[0]}
+    victim = next(n for n in r.replica_names()
+                  if n != owner[0])
+    kept = {n: f"http://{n}" for n in r.replica_names() if n != victim}
+    r.set_replicas(kept)
+    assert r.pick(blocks, session_key="sess-42")[0][0] == owner[0]
+    # Removing the owner reassigns the session to a survivor.
+    r.set_replicas({n: u for n, u in survivors.items()})
+    new_owner = r.pick(blocks, session_key="sess-42")[0]
+    assert new_owner[0] != owner[0]
+    assert new_owner[0] in survivors
+
+
+def test_shadow_tracks_eviction_and_restart():
+    r = Router({"a": "http://a"})
+    long_blocks = text_blocks("q" * 64 * 10)
+    r.record_route("a", long_blocks)
+    with r._lock:
+        assert r._replicas["a"].shadow.blocks == 10
+    # The replica's scraped shared-page count says it evicted to 4
+    # pages: the shadow trims to match (LRU), so the gateway stops
+    # expecting hits the replica can no longer serve.
+    r.observe_metrics("a", fams(serve_kv_pages_shared=4,
+                                serve_requests_total=100))
+    with r._lock:
+        assert r._replicas["a"].shadow.blocks == 4
+    # A serve_requests_total counter RESET (replica restarted, caches
+    # gone) clears the shadow entirely.
+    r.observe_metrics("a", fams(serve_kv_pages_shared=4,
+                                serve_requests_total=3))
+    with r._lock:
+        assert r._replicas["a"].shadow.blocks == 0
+
+
+def test_shadow_index_match_and_trim():
+    s = ShadowIndex(max_blocks=8)
+    a = token_blocks(list(range(64)), 16)
+    b = token_blocks(list(range(48)) + [999] * 16, 16)
+    s.record(a)
+    assert s.match(a) == 4
+    assert s.match(b) == 3  # shared 3-page prefix
+    s.record(b)
+    assert s.blocks == 5
+    s.trim(2)
+    assert s.blocks == 2
+
+
+def test_unhealthy_replicas_never_picked():
+    r = Router({"a": "http://a", "b": "http://b"})
+    r.observe_metrics("a", None)  # scrape failed
+    picks = r.pick(text_blocks("p" * 640))
+    assert [n for n, _ in picks] == ["b"]
+    r.observe_metrics("b", None)
+    assert r.pick(text_blocks("p" * 640)) == []
+    assert r.healthy_count() == 0
+
+
+def test_random_policy_routes_everywhere():
+    r = Router({f"r{i}": f"http://r{i}" for i in range(3)},
+               policy="random")
+    blocks = text_blocks("r" * 640)
+    r.record_route("r0", blocks)  # a shadow hit must NOT bias random
+    seen = {r.pick(blocks)[0][0] for _ in range(64)}
+    assert seen == {"r0", "r1", "r2"}
+
+
+# ---------------------------------------------------------------------------
+# HTTP gateway: proxy, failover, deadline
+# ---------------------------------------------------------------------------
+
+def fake_replica(name, behavior):
+    """A fake replica app: POST /v1/completions runs `behavior(body)` ->
+    (status, payload); /metrics renders a private registry."""
+    from aiohttp import web
+
+    reg = obs_metrics.Registry()
+    app = web.Application()
+    app["hits"] = []
+
+    async def completions(request):
+        body = await request.json()
+        app["hits"].append(body)
+        status, payload = behavior(body)
+        headers = ({"Retry-After": "1"} if status in (429, 503)
+                   else {})  # like serve/api.py's _reject
+        return web.json_response(payload, status=status, headers=headers)
+
+    async def metrics(request):
+        return web.Response(body=reg.render().encode(),
+                            headers={"Content-Type":
+                                     obs_metrics.CONTENT_TYPE})
+
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_get("/metrics", metrics)
+    app["registry"] = reg
+    return app
+
+
+def ok_behavior(body):
+    return 200, {"choices": [{"text": "ok", "finish_reason": "stop"}],
+                 "echo_timeout": body.get("timeout")}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_gateway_proxies_and_fails_over_preserving_deadline():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        from aiohttp import web
+
+        overloaded = fake_replica("a", lambda b: (429, {
+            "error": {"message": "full", "type": "overloaded"}}))
+        srv_a = TestServer(overloaded)
+        await srv_a.start_server()
+        # Second replica answers after a short delay so the forwarded
+        # deadline shrink is measurable.
+        srv_b_app = web.Application()
+        srv_b_app["hits"] = []
+
+        async def completions_b(request):
+            body = await request.json()
+            srv_b_app["hits"].append(body)
+            await asyncio.sleep(0.1)
+            return web.json_response(ok_behavior(body)[1])
+
+        srv_b_app.router.add_post("/v1/completions", completions_b)
+        srv_b = TestServer(srv_b_app)
+        await srv_b.start_server()
+
+        reg = obs_metrics.Registry()
+        gw = create_gateway(
+            {"a": f"http://127.0.0.1:{srv_a.port}",
+             "b": f"http://127.0.0.1:{srv_b.port}"},
+            scrape_interval_s=0,  # no poller thread in tests
+            registry=reg)
+        # Pin the routing order: 'a' holds the prefix, so the first pick
+        # is the overloaded replica and the request must fail over.
+        prompt = "s" * 640
+        gw["router"].record_route("a", text_blocks(prompt))
+        async with TestClient(TestServer(gw)) as client:
+            t0 = time.monotonic()
+            resp = await client.post("/v1/completions", json={
+                "prompt": prompt, "max_tokens": 4, "timeout": 5.0})
+            assert resp.status == 200
+            data = await resp.json()
+            assert resp.headers["X-Gateway-Replica"] == "b"
+            # Deadline-aware retry: the hop to b carries the REMAINING
+            # budget, not the original 5 s.
+            elapsed = time.monotonic() - t0
+            assert data["echo_timeout"] is not None
+            assert data["echo_timeout"] < 5.0
+            assert data["echo_timeout"] >= 5.0 - elapsed - 0.05
+            # The overloaded replica saw the request first.
+            assert len(overloaded["hits"]) == 1
+        # Metrics: one failover retry, decisions for both replicas.
+        assert reg.counter_value("gateway_retries_total",
+                                 reason="overloaded") == 1
+        assert reg.counter_value("gateway_route_decisions_total",
+                                 reason="prefix", backend="a") == 1
+        assert reg.counter_value("gateway_route_decisions_total",
+                                 reason="failover", backend="b") == 1
+        await srv_a.close()
+        await srv_b.close()
+
+    run(drive())
+
+
+def test_gateway_exhausted_deadline_is_504():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        overloaded = fake_replica("a", lambda b: (429, {
+            "error": {"message": "full"}}))
+        srv = TestServer(overloaded)
+        await srv.start_server()
+        gw = create_gateway({"a": f"http://127.0.0.1:{srv.port}",
+                             "a2": f"http://127.0.0.1:{srv.port}"},
+                            scrape_interval_s=0)
+        async with TestClient(TestServer(gw)) as client:
+            resp = await client.post("/v1/completions", json={
+                "prompt": "x", "timeout": 0.02})
+            # Budget burned before any replica accepted: 504 with the
+            # deadline type, NOT a silent unbounded retry loop.
+            assert resp.status in (429, 504)
+            if resp.status == 504:
+                data = await resp.json()
+                assert data["error"]["type"] == "deadline"
+        await srv.close()
+
+    run(drive())
+
+
+def test_gateway_all_replicas_overloaded_propagates_429():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        apps = [fake_replica(n, lambda b: (429, {
+            "error": {"message": "full", "type": "overloaded"}}))
+            for n in ("a", "b")]
+        servers = []
+        for app in apps:
+            srv = TestServer(app)
+            await srv.start_server()
+            servers.append(srv)
+        gw = create_gateway(
+            {n: f"http://127.0.0.1:{s.port}"
+             for n, s in zip(("a", "b"), servers)},
+            scrape_interval_s=0)
+        async with TestClient(TestServer(gw)) as client:
+            resp = await client.post("/v1/completions",
+                                     json={"prompt": "x"})
+            assert resp.status == 429
+            assert resp.headers.get("Retry-After")
+            assert all(len(a["hits"]) == 1 for a in apps)
+        for s in servers:
+            await s.close()
+
+    run(drive())
+
+
+def test_gateway_unready_without_backends():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        gw = create_gateway({}, scrape_interval_s=0)
+        async with TestClient(TestServer(gw)) as client:
+            resp = await client.get("/")
+            # Readiness fails while the gateway cannot route anywhere —
+            # the Serving gate depends on this (controller/server.py).
+            assert resp.status == 503
+            m = await client.get("/metrics")
+            text = await m.text()
+            assert "gateway_replicas_healthy 0" in text
+
+    run(drive())
+
+
+def test_metrics_poller_updates_router():
+    from aiohttp.test_utils import TestServer
+
+    async def drive():
+        app = fake_replica("a", ok_behavior)
+        app["registry"].set_gauge("serve_active_slots", 5)
+        app["registry"].set_gauge("serve_queue_depth", 2)
+        srv = TestServer(app)
+        await srv.start_server()
+        router = Router({"a": f"http://127.0.0.1:{srv.port}",
+                         "dead": "http://127.0.0.1:1"})
+        poller = MetricsPoller(router, timeout_s=1.0)
+        # poll_once is the poller THREAD's body (blocking urllib); off
+        # the loop or the scrape of the in-loop TestServer deadlocks.
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, poller.poll_once)
+        assert ok == 1
+        with router._lock:
+            assert router._replicas["a"].active_slots == 5
+            assert router._replicas["a"].queue_depth == 2
+            assert router._replicas["a"].healthy
+            assert not router._replicas["dead"].healthy
+        await srv.close()
+
+    run(drive())
+
+
+# ---------------------------------------------------------------------------
+# Validation
+# ---------------------------------------------------------------------------
+
+def test_validate_gateway_and_autoscale():
+    assert validate_gateway(None) is None
+    assert validate_gateway({"enabled": True, "replicas": 2,
+                             "policy": "prefix"}) is None
+    assert "unknown field" in validate_gateway({"enable": True})
+    assert "not one of" in validate_gateway({"policy": "roundrobin"})
+    assert ">= 1" in validate_gateway({"replicas": 0})
+    assert "must be a mapping" in validate_gateway("yes")
+
+    assert validate_autoscale(None) is None
+    assert validate_autoscale({"minReplicas": 1, "maxReplicas": 4}) is None
+    assert "required" in validate_autoscale({"minReplicas": 2})
+    assert ">= minReplicas" in validate_autoscale(
+        {"minReplicas": 3, "maxReplicas": 2})
+    assert "unknown field" in validate_autoscale(
+        {"maxReplicas": 2, "queueWait": 5})
+    assert "not a number" in validate_autoscale(
+        {"maxReplicas": 2, "queueWaitP90Ms": "fast"})
+    assert "> 0" in validate_autoscale(
+        {"maxReplicas": 2, "queueWaitP90Ms": 0})
+
+
+def test_invalid_gateway_block_surfaces_condition(harness):
+    client, ctx, mgr = harness
+    client.create(Server.new("bad", spec={
+        "image": "img", "model": {"name": "m"},
+        "gateway": {"policy": "nope"}}).obj)
+    mgr.reconcile_until_stable()
+    srv = client.get(API_VERSION, "Server", "default", "bad")
+    c = ko.get_condition(srv, cond.SERVING)
+    assert c["status"] == "False"
+    assert c["reason"] == cond.REASON_INVALID_PARAMS
+    assert "spec.gateway.policy" in c["message"]
+
+
+# ---------------------------------------------------------------------------
+# Controller: gateway deployment + serving gate
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def harness(tmp_path):
+    client = FakeCluster()
+    cloud = LocalCloud(CommonConfig(
+        cluster_name="testcluster",
+        artifact_bucket_url=f"file://{tmp_path}/bucket",
+        registry_url="registry.local:5000"))
+    ctx = Ctx(client=client, cloud=cloud, sci=FakeSCI())
+    mgr = Manager(ctx, [ModelReconciler(), ServerReconciler()])
+    return client, ctx, mgr
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    fl.FLEET.reset()
+    autoscale_mod.AUTOSCALE.reset()
+    yield
+    fl.FLEET.reset()
+    autoscale_mod.AUTOSCALE.reset()
+
+
+def ready_model_server(client, mgr, spec_extra, pods=("srv-0",)):
+    client.create(Model.new("m", spec={"image": "loader"}).obj)
+    client.create(Server.new("srv", spec={
+        "image": "img", "model": {"name": "m"}, **spec_extra}).obj)
+    # Replica pods exist so the reconciler's fleet-retain pass (which
+    # drops samples for vanished pods) keeps the seeded FLEET samples.
+    for pod in pods:
+        client.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod, "namespace": "default",
+                         "labels": {"server": "srv", "role": "run"}},
+            "spec": {}, "status": {"phase": "Running",
+                                   "podIP": "10.0.0.1"}})
+    mgr.reconcile_until_stable()
+    client.mark_job_complete("default", "m-modeller")
+    mgr.reconcile_until_stable()
+
+
+def test_gateway_deployment_and_serving_gate(harness):
+    client, ctx, mgr = harness
+    ready_model_server(client, mgr, {"gateway": {"enabled": True,
+                                                 "replicas": 2}})
+    gw = client.get("apps/v1", "Deployment", "default", "srv-gateway")
+    assert gw is not None
+    assert gw["spec"]["replicas"] == 2
+    tmpl = gw["spec"]["template"]
+    assert tmpl["metadata"]["labels"]["role"] == "gateway"
+    container = tmpl["spec"]["containers"][0]
+    assert container["command"] == ["python", "-m",
+                                    "runbooks_tpu.serve.gateway"]
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["RBT_GATEWAY_SERVER"] == "srv"
+    svc = client.get("v1", "Service", "default", "srv-gateway")
+    assert svc["spec"]["selector"] == {"server": "srv",
+                                      "role": "gateway"}
+
+    # Replicas ready but the gateway is not: the only ingress path is
+    # down, so the Server must NOT report serving (satellite fix).
+    client.mark_deployment_ready("default", "srv")
+    mgr.reconcile_until_stable()
+    srv = client.get(API_VERSION, "Server", "default", "srv")
+    c = ko.get_condition(srv, cond.SERVING)
+    assert c["status"] == "False"
+    assert "gateway" in c["message"]
+
+    client.mark_deployment_ready("default", "srv-gateway")
+    mgr.reconcile_until_stable()
+    srv = client.get(API_VERSION, "Server", "default", "srv")
+    c = ko.get_condition(srv, cond.SERVING)
+    assert c["status"] == "True"
+    assert "gateway ready" in c["message"]
+
+
+def test_scale_in_transition_keeps_serving(harness):
+    """spec.replicas=3 but the autoscaler has scaled the Deployment to 1
+    (>= minReplicas): ready_replicas=1 < spec.replicas must NOT read as
+    not-serving (the old gate compared against spec.replicas)."""
+    client, ctx, mgr = harness
+    ready_model_server(client, mgr, {
+        "replicas": 3,
+        "autoscale": {"minReplicas": 1, "maxReplicas": 3}})
+    # Autoscaler holds at 3 (no telemetry -> stale hold), Deployment=3.
+    dep = client.get("apps/v1", "Deployment", "default", "srv")
+    assert dep["spec"]["replicas"] == 3
+    # Force the book's desired down to 1 (as a sustained-idle run would).
+    st = autoscale_mod.AUTOSCALE.state_for(("default", "srv"))
+    st.desired = 1
+    client.mark_deployment_ready("default", "srv", replicas=1)
+    mgr.process_event("Server",
+                      client.get(API_VERSION, "Server", "default", "srv"))
+    srv = client.get(API_VERSION, "Server", "default", "srv")
+    assert ko.is_condition_true(srv, cond.SERVING)
+    dep = client.get("apps/v1", "Deployment", "default", "srv")
+    assert dep["spec"]["replicas"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler
+# ---------------------------------------------------------------------------
+
+def load_sample(replica, qw_s=0.0, n=20, active=0, queue=0, slots=4,
+                last_success=None):
+    """An up replica sample with a queue-wait histogram centered near
+    qw_s plus slot/queue gauges."""
+    import bisect
+
+    families = {}
+    if qw_s > 0:
+        fam = obs_metrics.ParsedFamily("serve_queue_wait_seconds",
+                                       "histogram")
+        hist = obs_metrics.ParsedHistogram()
+        hist.bounds = list(obs_metrics.DEFAULT_BUCKETS)
+        idx = bisect.bisect_left(hist.bounds, qw_s)
+        cum, acc = [], 0
+        for i in range(len(hist.bounds)):
+            if i == idx:
+                acc = n
+            cum.append(acc)
+        hist.cumulative = cum
+        hist.count = n
+        hist.sum = qw_s * n
+        fam.histograms[()] = hist
+        families["serve_queue_wait_seconds"] = fam
+    for name, val in (("serve_active_slots", active),
+                      ("serve_queue_depth", queue),
+                      ("serve_slots_total", slots),
+                      ("serve_requests_total", n)):
+        fam = obs_metrics.ParsedFamily(name, "gauge")
+        fam.samples[()] = float(val)
+        families[name] = fam
+    return fl.ReplicaSample(
+        replica, up=True,
+        last_success=(last_success if last_success is not None
+                      else time.monotonic()),
+        families=families)
+
+
+FAST = {"minReplicas": 1, "maxReplicas": 3, "queueWaitP90Ms": 50,
+        "scaleOutSustainS": 0, "scaleInSustainS": 0, "cooldownS": 0}
+
+
+def test_autoscaler_scales_out_and_back_controller_driven(harness):
+    """Acceptance: 1 -> N on sustained queue wait, N -> min on idle,
+    through the real reconciler and the real Deployment object."""
+    client, ctx, mgr = harness
+    ready_model_server(client, mgr, {"autoscale": dict(FAST)})
+    from runbooks_tpu.controller.metrics import REGISTRY
+
+    out_before = REGISTRY.counter_value(
+        "controller_autoscale_actions_total", server="srv",
+        namespace="default", direction="out")
+    in_before = REGISTRY.counter_value(
+        "controller_autoscale_actions_total", server="srv",
+        namespace="default", direction="in")
+    key = ("Server", "default", "srv")
+    # Sustained queue-wait p90 (~400 ms >> 50 ms target).
+    fl.FLEET.update(key, load_sample("srv-0", qw_s=0.4, active=4, queue=6))
+    for want in (2, 3, 3):  # capped at maxReplicas
+        mgr.process_event(
+            "Server", client.get(API_VERSION, "Server", "default", "srv"))
+        dep = client.get("apps/v1", "Deployment", "default", "srv")
+        assert dep["spec"]["replicas"] == want
+    srv = client.get(API_VERSION, "Server", "default", "srv")
+    autoscale_status = ko.deep_get(srv, "status", "autoscale")
+    assert autoscale_status["desiredReplicas"] == 3
+    assert autoscale_status["lastAction"] == "out"
+    assert REGISTRY.counter_value(
+        "controller_autoscale_actions_total", server="srv",
+        namespace="default", direction="out") == out_before + 2
+
+    # Load drains: queue empty, slots idle -> back down to min.
+    fl.FLEET.update(key, load_sample("srv-0", qw_s=0.0, active=0, queue=0))
+    for want in (2, 1, 1):  # floored at minReplicas
+        mgr.process_event(
+            "Server", client.get(API_VERSION, "Server", "default", "srv"))
+        dep = client.get("apps/v1", "Deployment", "default", "srv")
+        assert dep["spec"]["replicas"] == want
+    assert REGISTRY.counter_value(
+        "controller_autoscale_actions_total", server="srv",
+        namespace="default", direction="in") == in_before + 2
+
+
+def test_autoscaler_cooldown_limits_action_rate(harness):
+    client, ctx, mgr = harness
+    spec = dict(FAST, cooldownS=3600)
+    ready_model_server(client, mgr, {"autoscale": spec})
+    key = ("Server", "default", "srv")
+    fl.FLEET.update(key, load_sample("srv-0", qw_s=0.4, queue=6))
+    for _ in range(3):
+        mgr.process_event(
+            "Server", client.get(API_VERSION, "Server", "default", "srv"))
+    dep = client.get("apps/v1", "Deployment", "default", "srv")
+    # One action, then the cooldown holds every subsequent reconcile.
+    assert dep["spec"]["replicas"] == 2
+
+
+def test_autoscaler_sustain_requires_duration(monkeypatch):
+    """The overload signal must HOLD for scaleOutSustainS before an
+    action fires (a one-scrape blip is not sustained load)."""
+    clock = [1000.0]
+    monkeypatch.setattr(autoscale_mod, "_now", lambda: clock[0])
+    spec = {"minReplicas": 1, "maxReplicas": 3, "queueWaitP90Ms": 50,
+            "scaleOutSustainS": 30, "cooldownS": 0}
+    summary = {"replicasUp": 1, "queueWaitP90Ms": 400.0,
+               "activeSlots": 4, "queueDepth": 6, "slotsTotal": 4}
+    desired, action = autoscale_mod.evaluate(
+        ("ns", "s"), spec, {}, summary, False, 1.0, 20.0, 1)
+    assert (desired, action) == (1, None)  # onset recorded, not acted
+    clock[0] += 31
+    desired, action = autoscale_mod.evaluate(
+        ("ns", "s"), spec, {}, summary, False, 1.0, 20.0, 1)
+    assert desired == 2 and action["direction"] == "out"
+    assert "queueWaitP90Ms" in action["reason"]
+
+
+def test_autoscaler_holds_on_stale_telemetry(monkeypatch):
+    """Never act on a scrape older than 2 intervals — and a staleness
+    window must also reset the sustain onset (no banked pressure)."""
+    clock = [1000.0]
+    monkeypatch.setattr(autoscale_mod, "_now", lambda: clock[0])
+    spec = {"minReplicas": 1, "maxReplicas": 3, "queueWaitP90Ms": 50,
+            "scaleOutSustainS": 10, "cooldownS": 0}
+    summary = {"replicasUp": 1, "queueWaitP90Ms": 400.0,
+               "activeSlots": 4, "queueDepth": 6, "slotsTotal": 4}
+    autoscale_mod.evaluate(("ns", "h"), spec, {}, summary, False,
+                           1.0, 20.0, 1)  # onset at t=1000
+    clock[0] += 60
+    # Stale scrape (age 100 > 20): hold, despite 60 s of "pressure".
+    desired, action = autoscale_mod.evaluate(
+        ("ns", "h"), spec, {}, summary, False, 100.0, 20.0, 1)
+    assert (desired, action) == (1, None)
+    st = autoscale_mod.AUTOSCALE.state_for(("ns", "h"))
+    assert st.held_stale and st.out_since is None
+    # Fresh again: the sustain clock restarts from now.
+    desired, action = autoscale_mod.evaluate(
+        ("ns", "h"), spec, {}, summary, False, 1.0, 20.0, 1)
+    assert (desired, action) == (1, None)
+    clock[0] += 11
+    desired, action = autoscale_mod.evaluate(
+        ("ns", "h"), spec, {}, summary, False, 1.0, 20.0, 1)
+    assert desired == 2
+
+
+def test_autoscaler_slo_violation_triggers_scale_out(monkeypatch):
+    clock = [0.0]
+    monkeypatch.setattr(autoscale_mod, "_now", lambda: clock[0])
+    spec = {"minReplicas": 1, "maxReplicas": 2, "scaleOutSustainS": 0,
+            "cooldownS": 0}
+    summary = {"replicasUp": 1, "activeSlots": 4, "queueDepth": 2,
+               "slotsTotal": 4}
+    desired, action = autoscale_mod.evaluate(
+        ("ns", "v"), spec, {"ttftP99Ms": 100}, summary, True, 1.0, 20.0, 1)
+    assert desired == 2 and action["reason"] == "SLOViolated"
+
+
+def test_autoscaler_scale_in_respects_occupancy(monkeypatch):
+    """Scale-in fires only when the remaining replicas can absorb the
+    active slots at the configured occupancy."""
+    clock = [0.0]
+    monkeypatch.setattr(autoscale_mod, "_now", lambda: clock[0])
+    spec = {"minReplicas": 1, "maxReplicas": 4, "scaleInSustainS": 0,
+            "cooldownS": 0}
+    st = autoscale_mod.AUTOSCALE.state_for(("ns", "o"))
+    st.desired = 3
+    # 3 replicas x 4 slots, 5 active: (3-1)*4*0.5 = 4 < 5 -> hold.
+    busy = {"replicasUp": 3, "activeSlots": 5, "queueDepth": 0,
+            "slotsTotal": 12}
+    desired, action = autoscale_mod.evaluate(
+        ("ns", "o"), spec, {}, busy, False, 1.0, 20.0, 3)
+    assert (desired, action) == (3, None)
+    idle = dict(busy, activeSlots=3)  # 3 <= 4 -> scale in
+    desired, action = autoscale_mod.evaluate(
+        ("ns", "o"), spec, {}, idle, False, 1.0, 20.0, 3)
+    assert desired == 2 and action["direction"] == "in"
+
+
+def test_fleet_retain_drops_vanished_replicas(harness):
+    """Satellite: stale FleetState entries for scaled-in pods must drop
+    before the autoscaler reads per-replica aggregates."""
+    client, ctx, mgr = harness
+    ready_model_server(client, mgr, {"autoscale": dict(FAST)})
+    key = ("Server", "default", "srv")
+    # Two replicas scraped; srv-1's pod is gone (scale-in victim) and its
+    # last sample carries the WORST queue wait.
+    fl.FLEET.update(key, load_sample("srv-0", qw_s=0.0, active=0, queue=0))
+    fl.FLEET.update(key, load_sample("srv-1", qw_s=2.0, active=4, queue=9))
+    mgr.process_event("Server",
+                      client.get(API_VERSION, "Server", "default", "srv"))
+    # srv-1's sample is gone; the summary (and therefore any autoscale
+    # decision) no longer sees the dead pod's 2 s queue waits.
+    assert fl.FLEET.get_sample(key, "srv-1") is None
+    summary = fl.FLEET.server_summary("default", "srv")
+    assert summary["replicas"] == 1
+    assert summary.get("queueWaitP90Ms", 0) < 1000
+    # And the scale-in signal (idle survivor) can act on clean data.
+    dep = client.get("apps/v1", "Deployment", "default", "srv")
+    assert dep["spec"]["replicas"] == 1
+
+
+def test_retain_keeps_gateway_sample(harness):
+    """The reconciler's retain pass builds its live set from role=run
+    pods only — the gateway pod's sample (same workload key) must
+    survive it, or its mirrored series blank between scrape sweeps."""
+    client, ctx, mgr = harness
+    ready_model_server(client, mgr, {"autoscale": dict(FAST),
+                                     "gateway": {"enabled": True}})
+    key = ("Server", "default", "srv")
+    fl.FLEET.update(key, load_sample("srv-0", qw_s=0.0))
+    gw = load_sample("srv-gateway-x", qw_s=0.0)
+    fl.FLEET.update(key, dataclasses.replace(gw, role="gateway"))
+    mgr.process_event("Server",
+                      client.get(API_VERSION, "Server", "default", "srv"))
+    assert fl.FLEET.get_sample(key, "srv-gateway-x") is not None
+    # And the load aggregates still exclude it (role filter).
+    assert fl.FLEET.server_summary("default", "srv")["replicas"] == 1
+
+
+def test_autoscaler_survives_controller_restart(harness):
+    """The in-process AUTOSCALE book dies with the controller; the
+    .status.autoscale mirror must re-seed the next process's target so
+    a restart does not snap a scaled-out Deployment back to
+    spec.replicas under load."""
+    client, ctx, mgr = harness
+    ready_model_server(client, mgr, {"autoscale": dict(FAST)})
+    key = ("Server", "default", "srv")
+    fl.FLEET.update(key, load_sample("srv-0", qw_s=0.4, active=4, queue=6))
+    for _ in range(2):
+        mgr.process_event(
+            "Server", client.get(API_VERSION, "Server", "default", "srv"))
+    dep = client.get("apps/v1", "Deployment", "default", "srv")
+    assert dep["spec"]["replicas"] == 3
+    # "Restart": fresh book, same cluster state.
+    autoscale_mod.AUTOSCALE.reset()
+    mgr.process_event("Server",
+                      client.get(API_VERSION, "Server", "default", "srv"))
+    dep = client.get("apps/v1", "Deployment", "default", "srv")
+    assert dep["spec"]["replicas"] == 3  # not back to spec.replicas=1
+
+
+def test_disabling_gateway_deletes_deployment(harness):
+    """Flipping spec.gateway.enabled off must remove the gateway
+    Deployment + Service (a stale gateway would keep routing with
+    frozen config)."""
+    client, ctx, mgr = harness
+    ready_model_server(client, mgr, {"gateway": {"enabled": True}})
+    assert client.get("apps/v1", "Deployment", "default",
+                      "srv-gateway") is not None
+    srv = client.get(API_VERSION, "Server", "default", "srv")
+    srv["spec"]["gateway"] = {"enabled": False}
+    client.update(srv)
+    mgr.reconcile_until_stable()
+    assert client.get("apps/v1", "Deployment", "default",
+                      "srv-gateway") is None
+    assert client.get("v1", "Service", "default", "srv-gateway") is None
+
+
+def test_scraper_skips_terminating_pods(harness):
+    """A Terminating pod still reports phase=Running; the scraper must
+    leave it out of discovery (and FleetState) immediately."""
+    client, ctx, _ = harness
+    client.create(Server.new("srv", spec={"image": "x"}).obj)
+    from runbooks_tpu.obs.metrics import Registry, serve_metrics
+
+    reg = Registry()
+    reg.set_counter("serve_requests_total", 5)
+    httpd = serve_metrics(0, reg)
+    for name, deleting in (("srv-0", False), ("srv-1", True)):
+        meta = {"name": name, "namespace": "default",
+                "labels": {"server": "srv", "role": "run"},
+                "annotations": {fl.METRICS_PORT_ANNOTATION:
+                                str(httpd.server_address[1])}}
+        if deleting:
+            meta["deletionTimestamp"] = "2026-08-03T00:00:00Z"
+        client.create({"apiVersion": "v1", "kind": "Pod",
+                       "metadata": meta, "spec": {},
+                       "status": {"phase": "Running",
+                                  "podIP": "127.0.0.1"}})
+    registry, state = Registry(), fl.FleetState()
+    scraper = fl.FleetScraper(ctx, state=state, registry=registry)
+    try:
+        assert scraper.scrape_once() == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert state.get_sample(("Server", "default", "srv"), "srv-0") \
+        is not None
+    assert state.get_sample(("Server", "default", "srv"), "srv-1") is None
+
+
+def test_fleet_scrapes_gateway_pods_separately(harness):
+    """Gateway pods mirror their gateway_* families under the Server's
+    workload key but stay OUT of the load/SLO aggregates."""
+    client, ctx, _ = harness
+    client.create(Server.new("srv", spec={"image": "x"}).obj)
+    from runbooks_tpu.obs.metrics import Registry, serve_metrics
+
+    rep_reg = Registry()
+    rep_reg.set_gauge("serve_active_slots", 3)
+    rep_reg.set_counter("serve_requests_total", 5)
+    gw_reg = Registry()
+    gw_reg.inc("gateway_requests_total", 7)
+    gw_reg.set_gauge("gateway_replicas_healthy", 1)
+    httpd_rep = serve_metrics(0, rep_reg)
+    httpd_gw = serve_metrics(0, gw_reg)
+    for name, role, port in (
+            ("srv-0", "run", httpd_rep.server_address[1]),
+            ("srv-gateway-abc", "gateway", httpd_gw.server_address[1])):
+        client.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         "labels": {"server": "srv", "role": role},
+                         "annotations": {fl.METRICS_PORT_ANNOTATION:
+                                         str(port)}},
+            "spec": {}, "status": {"phase": "Running",
+                                   "podIP": "127.0.0.1"}})
+    registry, state = Registry(), fl.FleetState()
+    scraper = fl.FleetScraper(ctx, state=state, registry=registry)
+    try:
+        assert scraper.scrape_once() == 2
+    finally:
+        for h in (httpd_rep, httpd_gw):
+            h.shutdown()
+            h.server_close()
+    text = registry.render()
+    assert 'gateway_requests_total{kind="Server",name="srv",' \
+           'namespace="default",replica="srv-gateway-abc"} 7' in text
+    summary = state.server_summary("default", "srv")
+    # The gateway pod is not serving capacity.
+    assert summary["replicas"] == 1 and summary["replicasUp"] == 1
+    assert summary["activeSlots"] == 3
+
+
+def test_rbt_top_renders_gateway_row(capsys):
+    from runbooks_tpu.cli import main as cli
+    from runbooks_tpu.obs.metrics import Registry, serve_metrics
+
+    reg = Registry()
+    lbl = dict(kind="Server", namespace="default", name="srv",
+               replica="srv-gateway-x")
+    reg.set_gauge("fleet_scrape_up", 1, **lbl)
+    reg.set_gauge("fleet_scrape_age_seconds", 0.0, **lbl)
+    reg.set_counter("gateway_requests_total", 42, **lbl)
+    reg.set_gauge("gateway_replicas_healthy", 3, **lbl)
+    reg.set_counter("gateway_affinity_requests_total", 10, **lbl)
+    reg.set_counter("gateway_affinity_hits_total", 9, **lbl)
+    reg.set_counter("gateway_retries_total", 2,
+                    reason="overloaded", **lbl)
+    httpd = serve_metrics(0, reg)
+    try:
+        assert cli.main(["top", "--once", "--url",
+                         f"http://127.0.0.1:{httpd.server_address[1]}"]) \
+            == 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    out = capsys.readouterr().out
+    row = next(ln for ln in out.splitlines() if "srv-gateway-x" in ln)
+    assert "routed=42" in row and "backends=3" in row
+    assert "affinity=90%" in row and "retries=2" in row
+
+
+# ---------------------------------------------------------------------------
+# End to end: real engines behind the gateway, zero unexpected compiles
+# ---------------------------------------------------------------------------
+
+def tiny_cfg():
+    from runbooks_tpu.models.config import get_config
+
+    return dataclasses.replace(
+        get_config("llama2-7b"), vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=64, dtype="float32")
+
+
+def test_routed_traffic_compiles_nothing_unexpected():
+    """Two real (warmed) replicas behind the gateway: routed traffic —
+    including shared-prefix repeats and a failover-shaped burst — must
+    not trigger a single unexpected XLA compile on either replica."""
+    import jax
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.obs import device as obs_device
+    from runbooks_tpu.serve.api import create_server
+
+    cfg = tiny_cfg()
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+
+    async def drive():
+        apps = [create_server(cfg, params, max_slots=2, warmup=True)
+                for _ in range(2)]
+        servers = []
+        for app in apps:
+            srv = TestServer(app)
+            await srv.start_server()
+            servers.append(srv)
+        gw = create_gateway(
+            {f"r{i}": f"http://127.0.0.1:{s.port}"
+             for i, s in enumerate(servers)},
+            scrape_interval_s=0)
+        unexpected_before = obs_device.SENTINEL.unexpected
+        # Byte tokenizer + 64-token context: prompts must stay short.
+        shared = "All work and no play makes Jack"
+        async with TestClient(TestServer(gw)) as client:
+            for i in range(6):
+                resp = await client.post("/v1/completions", json={
+                    "prompt": shared + f" request {i}",
+                    "max_tokens": 3})
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["choices"][0]["text"] is not None
+                assert "X-Gateway-Replica" in resp.headers
+        assert obs_device.SENTINEL.unexpected == unexpected_before, \
+            "routed traffic must stay inside the warmed program set"
+        for s in servers:
+            await s.close()
+
+    run(drive())

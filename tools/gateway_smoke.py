@@ -1,0 +1,148 @@
+"""Gateway smoke: the real HTTP data plane end to end, with one JSON
+line for the sweep table.
+
+Spins 3 real paged-engine replicas (serve/api.create_server behind
+aiohttp test servers), puts the real gateway (serve/gateway.py) in
+front, and drives a multi-tenant shared-prefix workload — P distinct
+system prompts x M waves — twice: once with the k8s-Service baseline
+(policy=random) and once prefix-aware. The printed value is the
+per-replica ``serve_prefix_pages_reused_total`` per routed request
+uplift of prefix-aware over random routing; acceptance is >= 1.5x
+(vs_baseline = uplift / 1.5), with zero unexpected XLA compiles on any
+replica throughout (the compile sentinel is armed — a routing layer
+that perturbs replica program shapes would show here).
+
+Run: ``python tools/gateway_smoke.py [replicas]``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")  # repo-root invocation, like bench.py
+
+
+async def run_policy(policy: str, cfg, params, replicas: int,
+                     prefixes: list, waves: int, suffixes) -> dict:
+    """Fresh replica set + gateway for one routing policy; returns the
+    reuse stats. Engines are rebuilt per policy so the second run's
+    radix trees start cold (the jit cache persists across engines, so
+    only the first set pays the compile bill)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from runbooks_tpu.obs import metrics as obs_metrics
+    from runbooks_tpu.serve.api import create_server
+    from runbooks_tpu.serve.gateway import create_gateway
+
+    apps = [create_server(cfg, params, max_slots=4, max_seq_len=64,
+                          warmup=True, kv_paging=True, page_size=16,
+                          num_pages=64)
+            for _ in range(replicas)]
+    servers = []
+    for app in apps:
+        srv = TestServer(app)
+        await srv.start_server()
+        servers.append(srv)
+    gw = create_gateway(
+        {f"r{i}": f"http://127.0.0.1:{s.port}"
+         for i, s in enumerate(servers)},
+        policy=policy, block_chars=16, scrape_interval_s=0)
+    routed = 0
+    errors = []
+    async with TestClient(TestServer(gw)) as client:
+        for wave in range(waves):
+            results = await asyncio.gather(*(
+                client.post("/v1/completions", json={
+                    "prompt": prefixes[p] + suffixes[(wave, p)],
+                    "max_tokens": 4})
+                for p in range(len(prefixes))))
+            for resp in results:
+                if resp.status != 200:
+                    errors.append(f"{policy}: HTTP {resp.status}")
+                routed += 1
+    per_replica = {}
+    for i, app in enumerate(apps):
+        occ = app["worker"].engine.kv_occupancy()
+        per_replica[f"r{i}"] = occ["pages_reused_total"]
+    for srv in servers:
+        await srv.close()
+    del obs_metrics  # (imported for parity with the monitoring path)
+    return {"per_replica": per_replica,
+            "reuse_per_request": sum(per_replica.values())
+            / max(routed, 1),
+            "routed": routed, "errors": errors}
+
+
+async def main_async(replicas: int) -> dict:
+    import dataclasses
+
+    import jax
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.obs import device as obs_device
+
+    cfg = dataclasses.replace(
+        get_config("debug"), max_seq_len=64)
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+
+    # 32-char prefixes = 2 full 16-char routing blocks AND (byte
+    # tokenizer) 2 full 16-token KV pages; per-wave suffixes are private.
+    n_prefix, waves = 6, 4
+    prefixes = [f"tenant-{p:02d} system-prompt padding." for p in
+                range(n_prefix)]
+    assert all(len(p) == 32 for p in prefixes)
+    suffixes = {(w, p): f" u{w}{p}" for w in range(waves)
+                for p in range(n_prefix)}
+
+    unexpected_before = obs_device.SENTINEL.unexpected
+    random_stats = await run_policy("random", cfg, params, replicas,
+                                    prefixes, waves, suffixes)
+    prefix_stats = await run_policy("prefix", cfg, params, replicas,
+                                    prefixes, waves, suffixes)
+    unexpected = obs_device.SENTINEL.unexpected - unexpected_before
+    return {"random": random_stats, "prefix": prefix_stats,
+            "unexpected_compiles": unexpected}
+
+
+def main() -> int:
+    replicas = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    t0 = time.perf_counter()
+    stats = asyncio.run(main_async(replicas))
+    wall = time.perf_counter() - t0
+    uplift = (stats["prefix"]["reuse_per_request"]
+              / max(stats["random"]["reuse_per_request"], 1e-9))
+    errors = stats["random"]["errors"] + stats["prefix"]["errors"]
+    if stats["unexpected_compiles"]:
+        errors.append(f"{stats['unexpected_compiles']} unexpected XLA "
+                      "compiles under routed traffic")
+    if uplift < 1.5:
+        errors.append(f"prefix-aware reuse uplift {uplift:.2f}x below "
+                      "the 1.5x acceptance")
+    print(json.dumps({
+        "metric": f"gateway prefix-aware vs random page reuse "
+                  f"({replicas} replicas, HTTP end to end)",
+        "value": round(uplift, 2),
+        "unit": "x",
+        # Acceptance >= 1.5x (docs/serving-dataplane.md) -> > 1.0 holds.
+        "vs_baseline": round(uplift / 1.5, 4),
+        "prefix_reuse_per_request":
+            round(stats["prefix"]["reuse_per_request"], 3),
+        "random_reuse_per_request":
+            round(stats["random"]["reuse_per_request"], 3),
+        "prefix_per_replica": stats["prefix"]["per_replica"],
+        "random_per_replica": stats["random"]["per_replica"],
+        "routed_requests": stats["prefix"]["routed"]
+        + stats["random"]["routed"],
+        "unexpected_compiles": stats["unexpected_compiles"],
+        "wall_s": round(wall, 1),
+        "bench_errors": errors,
+    }))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
