@@ -320,6 +320,149 @@ def obs_inner() -> None:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def flight_inner() -> None:
+    """RBT_BENCH_FLIGHT=1: flight-recorder + tail-sampling overhead.
+
+    The flight recorder (obs/flight.py) is ALWAYS ON: every serve span
+    (prefill, decode chunk, queue-wait) now also appends to a bounded
+    in-memory ring, and every request finish runs the tail-sampling
+    decision. This axis bounds that cost three ways on a real warmed
+    engine: (a) a deterministic microbench of the exact per-decode-chunk
+    recording sequence (span enter/exit + ring append), reported as a
+    percent of the measured steady decode-chunk time — acceptance is
+    < 1%; (b) wall-clock decode throughput with the recorder on vs off
+    (RBT_FLIGHT=0), reported for the noise band; (c) the compile
+    sentinel across both windows — recording must add ZERO unexpected
+    XLA compiles (it is host-side only) — plus the boundedness proof:
+    the ring is resized small enough that the measured traffic MUST
+    wrap it, and the gate checks it actually DID (dropped > 0, length
+    pinned at capacity); an identity like len <= maxlen would pass
+    vacuously. RBT_BENCH_GATE_STRICT=1 exits 5 when any gate fails."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.obs import device as obs_device
+    from runbooks_tpu.obs import flight as obs_flight
+    from runbooks_tpu.obs import trace as obs_trace
+    from runbooks_tpu.serve.engine import InferenceEngine, Request
+
+    device = jax.devices()[0]
+    model = os.environ.get("RBT_BENCH_MODEL", "debug")
+    slots = int(os.environ.get("RBT_BENCH_SLOTS", "4"))
+    waves = int(os.environ.get("RBT_BENCH_WAVES", "6"))
+    cfg = get_config(model)
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+
+    workdir = tempfile.mkdtemp(prefix="rbt-flight-bench-")
+    os.environ["RBT_CONTENT_DIR"] = workdir  # tail promotions land here
+    os.environ.pop("RBT_TRACE", None)
+    # Tail threshold high enough that nothing promotes in the measured
+    # windows: steady state pays only the classification check.
+    os.environ["RBT_TRACE_TAIL_MS"] = "60000"
+    obs_trace.configure(os.path.join(workdir, "trace.jsonl"))
+    # Small ring so the measured windows genuinely WRAP it: the
+    # boundedness gate below proves the wrap happened, not the deque
+    # identity.
+    ring_cap = int(os.environ.get("RBT_BENCH_FLIGHT_RING", "128"))
+    obs_flight.RING.resize(ring_cap)
+    engine = InferenceEngine(cfg, params, max_slots=slots, seed=0)
+    engine.warmup()
+    sentinel = obs_device.SENTINEL
+    monitoring_live = sentinel.install()
+    unexpected_before = sentinel.unexpected
+
+    def wave(n_reqs, max_tokens=32):
+        reqs = [Request(prompt_tokens=list(range(1, 9)),
+                        max_tokens=max_tokens,
+                        request_id=f"bench-{i}")
+                for i in range(n_reqs)]
+        engine.generate(reqs)
+
+    def window():
+        steps0 = engine.steps
+        t0 = time.perf_counter()
+        for _ in range(waves):
+            wave(slots)
+        dt = time.perf_counter() - t0
+        return dt, engine.steps - steps0
+
+    # Warm one wave in each mode, then measure: recorder OFF first.
+    os.environ["RBT_FLIGHT"] = "0"
+    wave(slots)
+    dt_off, steps_off = window()
+    os.environ.pop("RBT_FLIGHT", None)  # default: recording ON
+    wave(slots)
+    dt_on, steps_on = window()
+    unexpected = sentinel.unexpected - unexpected_before
+    ring_stats = obs_flight.RING.stats()
+    # Meaningful boundedness: the traffic wrapped the ring (events were
+    # really dropped) AND the live length sits pinned at capacity.
+    ring_bounded = (ring_stats["dropped"] > 0
+                    and ring_stats["events"] == ring_stats["capacity"])
+
+    # Deterministic microbench: the per-decode-chunk recording sequence
+    # (one span with the engine's decode attrs) plus one tail-sampling
+    # decision, amortized.
+    from runbooks_tpu.obs.trace import span
+
+    n_micro = 5000
+    rids = [f"bench-{i}" for i in range(slots)]
+    t0 = time.perf_counter()
+    for i in range(n_micro):
+        with span("decode", view=256, active=slots, request_ids=rids):
+            pass
+        obs_flight.tail_sample(f"bench-{i % slots}", 0.001, "stop")
+    flight_us = (time.perf_counter() - t0) / n_micro * 1e6
+
+    step_time_s = dt_on / max(steps_on, 1)
+    overhead_pct = (flight_us / 1e6) / step_time_s * 100.0
+    obs_trace.close()
+    obs_trace.configure(None)
+    obs_flight.RING.resize(obs_flight.ring_capacity())
+
+    ok = (overhead_pct < 1.0 and unexpected == 0 and ring_bounded
+          and monitoring_live)
+    print(json.dumps({
+        "metric": f"{model} flight-recorder overhead "
+                  f"({slots} slots, ring {ring_stats['capacity']})",
+        "value": round(overhead_pct, 4),
+        "unit": "% of decode-chunk time",
+        # Acceptance < 1%: vs_baseline > 1 beats the bound (zeroed when
+        # a gate condition fails so the sweep table shows it).
+        "vs_baseline": (round(1.0 / max(overhead_pct, 1e-9), 2)
+                        if ok else 0.0),
+        "flight_us_per_step": round(flight_us, 2),
+        "decode_step_time_s": round(step_time_s, 6),
+        "steps_per_sec_flight_off": round(steps_off / dt_off, 3),
+        "steps_per_sec_flight_on": round(steps_on / dt_on, 3),
+        "wall_delta_pct": round((dt_on - dt_off) / dt_off * 100.0, 2),
+        "ring_events": ring_stats["events"],
+        "ring_capacity": ring_stats["capacity"],
+        "ring_recorded": ring_stats["recorded"],
+        "ring_dropped": ring_stats["dropped"],
+        "ring_bounded": ring_bounded,
+        "unexpected_compiles": unexpected,
+        "sentinel_monitoring": monitoring_live,
+        "platform": jax.default_backend(),
+        "device": str(device),
+    }))
+    shutil.rmtree(workdir, ignore_errors=True)
+    if os.environ.get("RBT_BENCH_GATE_STRICT") == "1" and not ok:
+        print("FLIGHT GATE: "
+              + (f"overhead {overhead_pct:.3f}% >= 1%" if
+                 overhead_pct >= 1.0 else
+                 f"{unexpected} unexpected compile(s)" if unexpected else
+                 "ring never wrapped / exceeded capacity"
+                 if not ring_bounded else
+                 "jax.monitoring feed unavailable")
+              + " (strict mode)", file=sys.stderr, flush=True)
+        raise SystemExit(5)
+
+
 def device_obs_inner() -> None:
     """RBT_BENCH_DEVICE_OBS=1: compile discipline + analytic MFU.
 
@@ -441,6 +584,8 @@ def inner() -> None:
         return resume_inner()
     if os.environ.get("RBT_BENCH_OBS") == "1":
         return obs_inner()
+    if os.environ.get("RBT_BENCH_FLIGHT") == "1":
+        return flight_inner()
     if os.environ.get("RBT_BENCH_DEVICE_OBS") == "1":
         return device_obs_inner()
     import jax

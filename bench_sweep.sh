@@ -153,6 +153,15 @@ run gateway-smoke python tools/gateway_smoke.py 3
 RBT_BENCH_SKIP_SERVE=1 run train-obs-overhead \
   env RBT_BENCH_OBS=1 python bench.py
 
+# 4b15. Flight recorder + tail sampling (docs/observability.md): the
+#       ALWAYS-ON span ring + per-finish tail-sampling decision on a
+#       real warmed engine — per-decode-chunk recording cost must stay
+#       < 1% of the steady decode-chunk time, with zero unexpected XLA
+#       compiles and the ring bounded at capacity under sustained
+#       traffic (strict mode exits 5 on any miss).
+RBT_BENCH_SKIP_SERVE=1 run serve-flight-overhead \
+  env RBT_BENCH_FLIGHT=1 RBT_BENCH_GATE_STRICT=1 python bench.py
+
 # 4b2. Device-level observability (docs/observability.md): zero
 #      unexpected XLA compiles across the steady-state step loop (the
 #      compile sentinel armed after the compile-folding first step;

@@ -161,6 +161,12 @@ def telemetry_summary(obj: dict) -> str:
             parts.append(f"tok/s={t['tokensPerSec']}")
     if "replicasUp" in t and "replicas" in t:
         parts.append(f"up={t['replicasUp']}/{t['replicas']}")
+    # Last-incident age from .status.lastIncident (controller-side
+    # SLO-onset captures; docs/observability.md "Incident snapshots").
+    inc = ko.deep_get(obj, "status", "lastIncident", default=None)
+    if isinstance(inc, dict) and inc.get("unixTime"):
+        age = max(0.0, time.time() - float(inc["unixTime"]))
+        parts.append(f"lastinc={age:.0f}s")
     return " ".join(parts)
 
 
@@ -629,6 +635,173 @@ def cmd_profile(args) -> int:
             pf.stop()
 
 
+def _fetch_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def _fetch_flight(base_url: str, request_id: str) -> dict:
+    """One /debug/flight query (serve replica or gateway)."""
+    from urllib.parse import quote
+
+    url = f"{base_url.rstrip('/')}/debug/flight"
+    if request_id:
+        url += f"?request_id={quote(request_id, safe='')}"
+    return _fetch_json(url)
+
+
+def _merged_timeline(sources: List[tuple]) -> List[tuple]:
+    """[(label, flight-response)] -> [(ts_us, label, event)] sorted by
+    wall-clock ts — one clock-ordered timeline across pods (hosts with
+    skewed clocks show as interleaving artifacts, which is exactly what
+    an operator needs to SEE rather than have hidden). Events identical
+    by (ts, pid, tid, name, dur) dedupe to the first source that
+    returned them — one process hosting several apps (tests, colocated
+    tiers) shares one ring, and a replica reachable under two names
+    must not double every row."""
+    merged = []
+    seen = set()
+    for label, resp in sources:
+        for event in resp.get("events", []):
+            ts = event.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            key = (ts, event.get("pid"), event.get("tid"),
+                   event.get("name"), event.get("dur"))
+            if key in seen:
+                continue
+            seen.add(key)
+            merged.append((float(ts), label, event))
+    merged.sort(key=lambda x: x[0])
+    return merged
+
+
+def _format_timeline(merged: List[tuple]) -> List[List[str]]:
+    """Rows for print_table: offset from the first event, source pod,
+    span name, duration, compact args."""
+    rows = []
+    t0 = merged[0][0] if merged else 0.0
+    for ts, label, event in merged:
+        args = dict(event.get("args") or {})
+        args.pop("request_id", None)
+        args.pop("request_ids", None)
+        detail = " ".join(f"{k}={v}" for k, v in sorted(args.items()))
+        dur = event.get("dur")
+        rows.append([
+            f"+{(ts - t0) / 1000.0:.1f}ms", label, event.get("name", "?"),
+            f"{dur / 1000.0:.1f}ms" if isinstance(dur, (int, float))
+            else "-",
+            detail[:60] or "-"])
+    return rows
+
+
+def cmd_trace(args) -> int:
+    """Merged gateway→replica timeline for one request id: query the
+    target's /debug/flight (obs/flight.py — the always-on span ring),
+    follow the replica map a gateway returns, and print every pod's
+    events for that id in one clock-ordered table
+    (docs/observability.md)."""
+    rid = args.request_id
+    url, pf = _resolve_server_url(
+        args, "usage: rbt trace <request-id> servers/<name> | --url URL")
+    try:
+        sources = []
+        try:
+            first = _fetch_flight(url, rid)
+        except (OSError, ValueError) as e:
+            print(f"trace: /debug/flight fetch failed: {e}",
+                  file=sys.stderr)
+            return 1
+        label = f"{first.get('component', '?')}@{first.get('host', '?')}"
+        sources.append((label, first))
+        # A gateway's response lists its backends: fetch each replica's
+        # ring too, so the timeline covers the whole path. The backend
+        # map carries pod URLs, which are routable in-cluster (where
+        # the gateway pod and CI smoke run) but NOT through a laptop's
+        # port-forward to the gateway alone — unreachable replicas
+        # degrade to a warning naming the per-replica fallback, never
+        # fail the merge.
+        unreachable = []
+        for name, rurl in sorted((first.get("replicas") or {}).items()):
+            try:
+                resp = _fetch_flight(rurl, rid)
+                sources.append(
+                    (f"{resp.get('component', '?')}@"
+                     f"{resp.get('host', '?')}/{name}", resp))
+            except (OSError, ValueError) as e:
+                unreachable.append(name)
+                print(f"trace: replica {name} ({rurl}) unreachable "
+                      f"({e}); timeline is partial", file=sys.stderr)
+        if unreachable:
+            print("trace: pod IPs are only routable in-cluster; for the "
+                  "replica half of the timeline, port-forward a replica "
+                  "and run `rbt trace <request-id> servers/<name>` (or "
+                  "--url the replica directly)", file=sys.stderr)
+        merged = _merged_timeline(sources)
+        if not merged:
+            print(f"no flight-recorder events for request id {rid!r} "
+                  f"(ring window passed, or the id never served here)")
+            return 1
+        print(f"request {rid}: {len(merged)} events across "
+              f"{len(sources)} pod(s)")
+        print_table(_format_timeline(merged),
+                    ["TIME", "POD", "EVENT", "DUR", "DETAIL"])
+        return 0
+    finally:
+        if pf is not None:
+            pf.stop()
+
+
+def cmd_incidents(args) -> int:
+    """List / fetch incident bundles (obs/incident.py) from a Server
+    replica: `rbt incidents servers/<name>` tables the bundles under
+    {artifacts}/incidents/; `--fetch NAME` downloads one bundle's full
+    JSON locally for offline triage."""
+    url, pf = _resolve_server_url(
+        args, "usage: rbt incidents servers/<name> [--fetch NAME] "
+              "| --url URL")
+    try:
+        base = url.rstrip("/")
+        if args.fetch:
+            from urllib.parse import quote
+
+            try:
+                bundle = _fetch_json(
+                    f"{base}/debug/incidents?name="
+                    f"{quote(args.fetch, safe='')}")
+            except urllib.error.HTTPError as e:
+                print(f"incidents: fetch failed ({e.code})",
+                      file=sys.stderr)
+                return 1
+            except (OSError, ValueError) as e:
+                print(f"incidents: fetch failed: {e}", file=sys.stderr)
+                return 1
+            out_path = args.out or args.fetch
+            with open(out_path, "w") as f:
+                json.dump(bundle, f, indent=1)
+            print(f"wrote {out_path} (reason={bundle.get('reason')}, "
+                  f"{len(bundle.get('flight', {}).get('events', []))} "
+                  "flight events)")
+            return 0
+        try:
+            listing = _fetch_json(f"{base}/debug/incidents")
+        except (OSError, ValueError) as e:
+            print(f"incidents: list failed: {e}", file=sys.stderr)
+            return 1
+        incidents = listing.get("incidents", [])
+        if not incidents:
+            print("no incident bundles captured")
+            return 0
+        rows = [[e.get("name", "?"), e.get("reason", "?"),
+                 e.get("time", "?"), str(e.get("size_bytes", "?"))]
+                for e in incidents]
+        print_table(rows, ["BUNDLE", "REASON", "TIME (UTC)", "BYTES"])
+        return 0
+    finally:
+        if pf is not None:
+            pf.stop()
+
+
 def _fetch_exposition(url: str) -> str:
     target = url if url.endswith("/metrics") else url.rstrip("/") + "/metrics"
     with urllib.request.urlopen(target, timeout=10) as resp:
@@ -803,6 +976,12 @@ def _top_detail(families, kind: str, sel: dict) -> str:
             parts.append(f"ttft99={ttft:.1f}ms")
         if tps is not None:
             parts.append(f"tok/s={tps:g}")
+        # Last-incident age (obs/incident.py): the series exists only
+        # once the replica captured a bundle — absence means "never".
+        inc_age = _metric_value(families, "serve_incident_age_seconds",
+                                sel)
+        if inc_age is not None:
+            parts.append(f"lastinc={inc_age:.0f}s")
     else:
         step = _metric_value(families, "train_step", sel)
         loss = _metric_value(families, "train_loss", sel)
@@ -1124,6 +1303,29 @@ def build_parser() -> argparse.ArgumentParser:
                     help="print one snapshot and exit")
     sp.add_argument("--timeout", type=float, default=720.0)
     sp.set_defaults(func=cmd_top)
+
+    sp = sub.add_parser(
+        "trace",
+        help="merged gateway→replica timeline for one request id")
+    sp.add_argument("request_id")
+    sp.add_argument("scope", nargs="?", default="",
+                    help="servers/<name> to port-forward (a gateway "
+                         "--url merges its replicas too)")
+    sp.add_argument("--url", help="gateway or replica URL (skips "
+                                  "port-forward)")
+    sp.add_argument("--timeout", type=float, default=720.0)
+    sp.set_defaults(func=cmd_trace)
+
+    sp = sub.add_parser("incidents",
+                        help="list/fetch incident bundles from a Server")
+    sp.add_argument("scope", nargs="?", default="")
+    sp.add_argument("--url", help="server URL (skips port-forward)")
+    sp.add_argument("--fetch", metavar="NAME",
+                    help="download one bundle's JSON")
+    sp.add_argument("--out", help="local path for --fetch (default: "
+                                  "the bundle name)")
+    sp.add_argument("--timeout", type=float, default=720.0)
+    sp.set_defaults(func=cmd_incidents)
 
     sp = sub.add_parser("logs", help="stream workload pod logs")
     sp.add_argument("scope")

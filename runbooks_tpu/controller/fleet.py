@@ -47,14 +47,46 @@ SCRAPE_KINDS: Tuple[Tuple[str, str], ...] = (("Server", "server"),
 # compile sentinel, HBM gauges, program roofline) mirror so per-replica
 # HBM headroom and unexpected-compile storms are visible from the single
 # fleet scrape point. gateway_* (serve/gateway.py) makes the routing
-# data plane's decisions/affinity/latency visible the same way.
-MIRROR_PREFIXES = ("serve_", "train_", "xla_", "device_", "gateway_")
+# data plane's decisions/affinity/latency visible the same way, and
+# flight_* (obs/flight.py) carries each pod's flight-recorder ring
+# depth beside them.
+MIRROR_PREFIXES = ("serve_", "train_", "xla_", "device_", "gateway_",
+                   "flight_")
 
 METRICS_PORT_ANNOTATION = "runbooks-tpu.dev/metrics-port"
 DEFAULT_METRICS_PORT = 8080
 DEFAULT_INTERVAL_S = 10.0
 
 WorkloadKey = Tuple[str, str, str]  # kind, namespace, name
+
+
+def pod_base_url(pod: dict) -> Optional[str]:
+    """``http://<podIP>:<port>`` for a workload pod, or None without an
+    IP. Port resolution order: the metrics-port annotation, a named
+    container port ("metrics"/"http-serve"), then the default. Shared by
+    the scraper (which appends /metrics) and the Server reconciler's
+    incident fan-out (which POSTs /debug/incident to the same pods)."""
+    ip = ko.deep_get(pod, "status", "podIP")
+    if not ip:
+        return None
+    port = ko.annotations(pod).get(METRICS_PORT_ANNOTATION)
+    if port is None:
+        # Named container port: the serve Deployment exposes
+        # "http-serve" (metrics live on the serving port), train Jobs
+        # expose "metrics" (RBT_METRICS_PORT).
+        for container in ko.deep_get(pod, "spec", "containers",
+                                     default=[]) or []:
+            for p in container.get("ports", []) or []:
+                if p.get("name") in ("metrics", "http-serve"):
+                    port = p.get("containerPort")
+                    break
+            if port is not None:
+                break
+    try:
+        port = int(port) if port is not None else DEFAULT_METRICS_PORT
+    except (TypeError, ValueError):
+        port = DEFAULT_METRICS_PORT
+    return f"http://{ip}:{port}"
 
 
 @dataclasses.dataclass
@@ -262,27 +294,8 @@ class FleetScraper:
     # -- discovery ------------------------------------------------------
 
     def _pod_url(self, pod: dict) -> Optional[str]:
-        ip = ko.deep_get(pod, "status", "podIP")
-        if not ip:
-            return None
-        port = ko.annotations(pod).get(METRICS_PORT_ANNOTATION)
-        if port is None:
-            # Named container port: the serve Deployment exposes
-            # "http-serve" (metrics live on the serving port), train Jobs
-            # expose "metrics" (RBT_METRICS_PORT).
-            for container in ko.deep_get(pod, "spec", "containers",
-                                         default=[]) or []:
-                for p in container.get("ports", []) or []:
-                    if p.get("name") in ("metrics", "http-serve"):
-                        port = p.get("containerPort")
-                        break
-                if port is not None:
-                    break
-        try:
-            port = int(port) if port is not None else DEFAULT_METRICS_PORT
-        except (TypeError, ValueError):
-            port = DEFAULT_METRICS_PORT
-        return f"http://{ip}:{port}/metrics"
+        base = pod_base_url(pod)
+        return f"{base}/metrics" if base else None
 
     def _discover(self) -> List[Tuple[WorkloadKey, dict]]:
         out: List[Tuple[WorkloadKey, dict]] = []

@@ -179,6 +179,9 @@ class _Health(BaseHTTPRequestHandler):
 
 
 def main() -> int:
+    from runbooks_tpu.obs import flight as obs_flight
+
+    obs_flight.set_component("controller")
     ctx = build_ctx()
     mgr = make_manager(ctx)
 

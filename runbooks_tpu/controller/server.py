@@ -12,7 +12,11 @@ multi-host serving engine).
 
 from __future__ import annotations
 
-from typing import Optional
+import json
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
 
 from runbooks_tpu.api import conditions as cond
 from runbooks_tpu.api.types import Server
@@ -45,6 +49,99 @@ GATEWAY_PORT = 8080
 # fresh scrapes even with no spec/dependency events. Autoscaling Servers
 # share the cadence: sustain/cooldown windows need regular evaluation.
 SLO_REQUEUE_S = 5.0
+
+# Per-replica POST /debug/incident timeout. Short: the fan-out runs on
+# a side thread, but a wedged replica should not pin that thread long.
+INCIDENT_POST_TIMEOUT_S = 2.0
+
+
+class _IncidentBook:
+    """Async incident fan-out for SLOViolated onsets.
+
+    The reconcile path does no network of its own (the scraper owns
+    that); firing ``POST /debug/incident`` at every replica inline
+    would block a reconcile for seconds on a wedged pod. So an onset
+    fire()s a daemon thread that POSTs each replica and parks the
+    results here; the NEXT reconcile (Servers with spec.slo requeue
+    every SLO_REQUEUE_S) folds them into ``.status.lastIncident``.
+    In-process state, like AUTOSCALE — a controller restart just
+    re-fires on the next onset."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._results: Dict[Tuple[str, str], dict] = {}  # guarded-by: _lock
+        self._threads: Dict[Tuple[str, str], threading.Thread] = {}  # guarded-by: _lock
+
+    def reset(self) -> None:
+        with self._lock:
+            self._results.clear()
+            self._threads.clear()
+
+    def fire(self, key: Tuple[str, str], reason: str,
+             targets: List[Tuple[str, str]]) -> None:
+        """Start one capture sweep over [(replica, base_url)] unless one
+        is already in flight for this Server."""
+        with self._lock:
+            running = self._threads.get(key)
+            if running is not None and running.is_alive():
+                return
+            thread = threading.Thread(
+                target=self._sweep, args=(key, reason, list(targets)),
+                name=f"rbt-incident-{key[1]}", daemon=True)
+            self._threads[key] = thread
+        thread.start()
+
+    def _sweep(self, key, reason, targets) -> None:
+        bundles = []
+        for replica, base in targets:
+            entry = {"replica": replica}
+            try:
+                req = urllib.request.Request(
+                    base + "/debug/incident",
+                    data=json.dumps({"reason": reason}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=INCIDENT_POST_TIMEOUT_S) as resp:
+                    body = json.loads(resp.read().decode("utf-8",
+                                                         "replace"))
+                if body.get("path"):
+                    entry["path"] = body["path"]
+                else:
+                    entry["debounced"] = True
+            except (OSError, ValueError):
+                entry["error"] = "unreachable"
+            bundles.append(entry)
+        wall = time.time()
+        with self._lock:
+            self._results[key] = {
+                "reason": reason,
+                "time": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime(wall)),
+                "unixTime": round(wall, 3),
+                "bundles": bundles,
+            }
+
+    def take(self, key: Tuple[str, str]) -> Optional[dict]:
+        """Pop-on-read: once a reconcile folds the sweep into
+        `.status.lastIncident` the status object is the durable record,
+        and keeping the entry would (a) grow the book for every Server
+        ever fired and (b) hand a deleted-and-recreated Server its
+        predecessor's incident on the new object's first reconcile."""
+        with self._lock:
+            return self._results.pop(key, None)
+
+    def wait(self, key: Tuple[str, str], timeout_s: float = 10.0) -> bool:
+        """Block until the in-flight sweep for `key` finishes (tests)."""
+        with self._lock:
+            thread = self._threads.get(key)
+        if thread is None:
+            return True
+        thread.join(timeout=timeout_s)
+        return not thread.is_alive()
+
+
+# Process-wide book (same pattern as autoscale.AUTOSCALE).
+INCIDENTS = _IncidentBook()
 
 
 class ServerReconciler:
@@ -91,10 +188,11 @@ class ServerReconciler:
         # Fleet telemetry + SLOs (controller/fleet.py): the scrape loop
         # populates FLEET between reconciles; this pass only folds the
         # latest aggregate into .status.telemetry and the SLOViolated
-        # condition — no network from the reconciler itself. Runs BEFORE
-        # the autoscale decision so the decision sees this reconcile's
-        # verdict, not the last one's.
-        changed = self._apply_telemetry_and_slo(server)
+        # condition — no network from the reconciler itself (the
+        # SLO-onset incident fan-out POSTs from a side thread; see
+        # _IncidentBook). Runs BEFORE the autoscale decision so the
+        # decision sees this reconcile's verdict, not the last one's.
+        changed = self._apply_telemetry_and_slo(ctx, server)
 
         autoscale_spec = server.spec.get("autoscale") or {}
         replicas = server.spec.get("replicas", 1)
@@ -248,7 +346,7 @@ class ServerReconciler:
 
     # ------------------------------------------------------------------
 
-    def _apply_telemetry_and_slo(self, server: Server) -> bool:
+    def _apply_telemetry_and_slo(self, ctx: Ctx, server: Server) -> bool:
         from runbooks_tpu.controller.fleet import FLEET
         from runbooks_tpu.controller.metrics import REGISTRY
 
@@ -256,6 +354,14 @@ class ServerReconciler:
         summary = FLEET.server_summary(server.namespace, server.name)
         if summary is not None and server.status.get("telemetry") != summary:
             server.status["telemetry"] = summary
+            changed = True
+        # Fold a finished incident fan-out (this onset's or an earlier
+        # one's — the sweep runs on a side thread) into status so
+        # `.status.lastIncident` points at the latest bundles.
+        incident = INCIDENTS.take((server.namespace, server.name))
+        if incident is not None \
+                and server.status.get("lastIncident") != incident:
+            server.status["lastIncident"] = incident
             changed = True
 
         slo = server.spec.get("slo") or {}
@@ -287,6 +393,13 @@ class ServerReconciler:
                     server=server.name, objective=reason,
                     help_text="SLOViolated condition onsets, by server "
                               "and first violated objective.")
+                # Capture the evidence WHILE the violation is live:
+                # every replica snapshots its flight ring / memory /
+                # program census into an incident bundle (debounced
+                # replica-side). Fan-out runs on a daemon thread; the
+                # next reconcile folds the bundle paths into status.
+                self._fire_incident_capture(ctx, server,
+                                            f"slo_{reason}")
         else:
             changed |= server.set_condition(
                 cond.SLO_VIOLATED, False, cond.REASON_SLO_MET,
@@ -299,6 +412,30 @@ class ServerReconciler:
             help_text="1 while the Server's SLOViolated condition is "
                       "true.")
         return changed
+
+    @staticmethod
+    def _fire_incident_capture(ctx: Ctx, server: Server,
+                               reason: str) -> None:
+        """Start the per-replica POST /debug/incident sweep for one
+        SLOViolated onset (run pods only — the gateway has no engine
+        state worth bundling)."""
+        from runbooks_tpu.controller.fleet import pod_base_url
+
+        targets: List[Tuple[str, str]] = []
+        for pod in ctx.client.list("v1", "Pod", namespace=server.namespace,
+                                   label_selector={"server": server.name,
+                                                   "role": "run"}):
+            if ko.deep_get(pod, "metadata", "deletionTimestamp",
+                           default=None):
+                continue
+            if ko.deep_get(pod, "status", "phase", default="") != "Running":
+                continue
+            base = pod_base_url(pod)
+            if base:
+                targets.append((ko.name(pod), base))
+        if targets:
+            INCIDENTS.fire((server.namespace, server.name), reason,
+                           targets)
 
     @staticmethod
     def _violations(slo: dict, summary) -> list:

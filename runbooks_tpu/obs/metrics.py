@@ -124,6 +124,13 @@ CATALOG: Dict[str, str] = {
     "gateway_proxy_latency_seconds": "histogram",
     "gateway_replicas_healthy": "gauge",
     "gateway_shadow_blocks": "gauge",
+    # Flight recorder + distributed tracing + incident snapshots
+    # (obs/flight.py, obs/incident.py, docs/observability.md)
+    "flight_ring_events": "gauge",
+    "serve_tail_samples_total": "counter",
+    "serve_incidents_total": "counter",
+    "serve_incident_age_seconds": "gauge",
+    "gateway_trace_spans_total": "counter",
     # process
     "process_uptime_seconds": "gauge",
 }

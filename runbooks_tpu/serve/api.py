@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import asyncio
 import json
-import re
 import threading
 import time
 import uuid
@@ -27,6 +26,12 @@ from typing import Any, Optional, Tuple
 from aiohttp import web
 
 from runbooks_tpu.models.config import ModelConfig, get_config
+from runbooks_tpu.obs import flight as obs_flight
+from runbooks_tpu.obs import incident as obs_incident
+# request_scope lives in obs/trace.py (shared with the gateway, which
+# must not import this module's JAX engine stack); re-exported here for
+# back-compat with existing importers.
+from runbooks_tpu.obs.trace import request_scope  # noqa: F401
 from runbooks_tpu.serve.engine import (
     EngineDraining,
     EngineOverloaded,
@@ -55,41 +60,6 @@ def _eos_id(tok) -> Optional[int]:
         if val is not None:
             return int(val)
     return None
-
-
-# W3C trace context (https://www.w3.org/TR/trace-context/):
-# version-traceid-parentid-flags, all lowercase hex.
-_TRACEPARENT_RE = re.compile(
-    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
-# Client-supplied ids flow into response headers, logs, and trace JSON:
-# strip anything that could split a header or forge a log line.
-_RID_UNSAFE_RE = re.compile(r"[^A-Za-z0-9._:/-]")
-
-
-def request_scope(headers) -> Tuple[str, Optional[str]]:
-    """(request_id, traceparent_out) for one HTTP request.
-
-    X-Request-Id is accepted verbatim (sanitized); a W3C ``traceparent``
-    is also honored — its trace-id becomes the request id when no
-    explicit one came, and the response carries a child ``traceparent``
-    (same trace-id, fresh parent-id) so an upstream tracer can stitch
-    the hop. With neither header, an id is generated. The id rides the
-    queue/prefill/decode trace spans (obs/trace.py) and the access log,
-    so one Perfetto trace follows one request across the engine."""
-    rid = headers.get("X-Request-Id") if headers else None
-    tp_out = None
-    tp = (headers.get("traceparent", "") if headers else "").strip().lower()
-    m = _TRACEPARENT_RE.match(tp)
-    if m:
-        tp_out = (f"{m.group(1)}-{m.group(2)}-"
-                  f"{uuid.uuid4().hex[:16]}-{m.group(4)}")
-        if not rid:
-            rid = m.group(2)
-    if rid:
-        rid = _RID_UNSAFE_RE.sub("", str(rid))[:128]
-    if not rid:
-        rid = f"req-{uuid.uuid4().hex[:16]}"
-    return rid, tp_out
 
 
 def load_model(params: dict) -> Tuple[ModelConfig, Any]:
@@ -371,9 +341,35 @@ class EngineWorker:
                     self._prefix_jobs = []
                 self._prefix_warm_queue.clear()
                 self._prefix_warm_buffers = None
-                for _req, fut in doomed + doomed_prefix:
+                now = time.monotonic()
+                for req, fut in doomed:
                     if not fut.done():
                         fut.set_exception(exc)
+                    # Error tail sampling: each doomed request's flight
+                    # timeline is worth keeping — these are exactly the
+                    # traces a postmortem needs.
+                    obs_flight.tail_sample(
+                        req.request_id,
+                        now - req._submitted if req._submitted else 0.0,
+                        req.finish_reason or "error", error=True)
+                for _tokens, fut in doomed_prefix:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                # Automatic incident snapshot (debounced/rate-limited in
+                # obs/incident.py) BEFORE reset() reallocates the cache:
+                # the bundle's memory census shows the crashed state.
+                # capture() never raises — the reset below must run.
+                try:
+                    groups = self.engine.memory_groups()
+                except Exception:  # noqa: BLE001 — torn engine state
+                    groups = None
+                obs_incident.capture(
+                    "engine_crash", component="serve",
+                    memory_groups=groups,
+                    extra={"error": repr(exc),
+                           "doomed_requests": [r.request_id
+                                               for r, _ in doomed],
+                           "doomed_prefix_jobs": len(doomed_prefix)})
                 # Donated buffers (cache) may have been invalidated by the
                 # failed call — full reset reallocates them.
                 self.engine.reset()
@@ -503,6 +499,9 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         engine.warmup(prefix_build=warm_prefix)
     worker = EngineWorker(engine,
                           warn_cold_prefix=not (warmup and warm_prefix))
+    # Flight/trace identity: this process's events label as the serving
+    # tier in merged timelines and /debug/flight envelopes.
+    obs_flight.set_component("serve")
     app = web.Application()
     app["worker"] = worker
     app["tokenizer"] = tokenizer
@@ -620,6 +619,18 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                                       "page, not per admission).")
         obs_device.set_memory_gauges(reg)
         obs_device.PROGRAMS.set_gauges(reg, component="serve")
+        # Flight recorder + incident freshness (docs/observability.md):
+        # ring depth mirrors to the fleet (MIRROR_PREFIXES carries
+        # flight_*), and the last-incident age feeds `rbt top`.
+        reg.set_gauge("flight_ring_events",
+                      obs_flight.RING.stats()["events"],
+                      help_text="Events currently held in the in-memory "
+                                "flight-recorder ring.")
+        inc_age = obs_incident.MANAGER.last_age()
+        if inc_age is not None:
+            reg.set_gauge("serve_incident_age_seconds", round(inc_age, 1),
+                          help_text="Seconds since this process captured "
+                                    "its last incident bundle.")
         body = reg.render().encode("utf-8")
         return web.Response(
             body=body, headers={"Content-Type": obs_metrics.CONTENT_TYPE})
@@ -732,6 +743,62 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                       "ridge_flops_per_byte": round(
                           peak_flops / hbm_bps, 3)},
         })
+
+    async def debug_flight(request: web.Request) -> web.Response:
+        """GET /debug/flight[?request_id=]: the always-on flight-recorder
+        ring (obs/flight.py) — the last N span/instant events, filtered
+        to one request's timeline when a request_id is given. The
+        envelope carries host/pid/component so `rbt trace` can merge
+        rings from the gateway and every replica into one clock-ordered
+        timeline."""
+        rid = request.query.get("request_id")
+        return web.json_response({
+            **obs_flight.identity(),
+            "stats": obs_flight.RING.stats(),
+            "events": obs_flight.RING.snapshot(request_id=rid or None),
+        })
+
+    async def debug_incident(request: web.Request) -> web.Response:
+        """POST /debug/incident {"reason": ...}: capture an incident
+        bundle on demand (the controller fires this at every replica on
+        an SLOViolated onset). Debounced server-side — a repeat inside
+        the window returns {"debounced": true} instead of a second
+        bundle."""
+        reason = "manual"
+        if request.can_read_body:
+            try:
+                reason = str((await request.json()).get("reason")
+                             or "manual")
+            except (json.JSONDecodeError, AttributeError):
+                reason = "manual"
+        eng = worker.engine
+        try:
+            groups = eng.memory_groups()
+        except Exception:  # noqa: BLE001 — diagnostics, not serving
+            groups = None
+        # Off the event loop: the memory census walks jax.live_arrays.
+        path = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: obs_incident.capture(
+                reason, component="serve", memory_groups=groups,
+                extra={"source": "http"}))
+        return web.json_response({"path": path,
+                                  "debounced": path is None})
+
+    async def debug_incidents(request: web.Request) -> web.Response:
+        """GET /debug/incidents: list captured bundles (newest first);
+        ?name=<bundle> fetches one bundle's full JSON (`rbt incidents`
+        drives both)."""
+        name = request.query.get("name")
+        if name:
+            bundle = obs_incident.read_incident(name)
+            if bundle is None:
+                return web.json_response(
+                    {"error": {"message": f"no incident bundle {name!r}"}},
+                    status=404)
+            return web.json_response(bundle)
+        return web.json_response(
+            {"incidents": obs_incident.list_incidents(),
+             "last_path": obs_incident.MANAGER.last_path()})
 
     async def completions(request: web.Request) -> web.Response:
         try:
@@ -1109,6 +1176,9 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     app.router.add_post("/debug/profile", debug_profile)
     app.router.add_get("/debug/memory", debug_memory)
     app.router.add_get("/debug/programs", debug_programs)
+    app.router.add_get("/debug/flight", debug_flight)
+    app.router.add_post("/debug/incident", debug_incident)
+    app.router.add_get("/debug/incidents", debug_incidents)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/prefix", register_prefix)

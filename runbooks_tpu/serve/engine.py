@@ -38,6 +38,7 @@ inference is in-framework and TPU-shaped:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable, List, Optional
 
@@ -48,9 +49,10 @@ import numpy as np
 from runbooks_tpu.models.config import ModelConfig
 from runbooks_tpu.models.transformer import KVCache, forward
 from runbooks_tpu.obs import device as obs_device
+from runbooks_tpu.obs import flight as obs_flight
 from runbooks_tpu.obs import metrics as obs_metrics
 from runbooks_tpu.obs.trace import complete as trace_complete
-from runbooks_tpu.obs.trace import span, trace_enabled
+from runbooks_tpu.obs.trace import record_enabled, span
 from runbooks_tpu.ops.sampling import sample
 
 Params = Any
@@ -65,11 +67,16 @@ _INTER_TOKEN_BUCKETS = (
 
 def _observe_request_done(req: "Request", now: float) -> None:
     """Terminal latency accounting for one request (normal finish or
-    deadline expiry): end-to-end duration, labeled by finish reason."""
+    deadline expiry): end-to-end duration, labeled by finish reason —
+    plus the tail-sampling decision (obs/flight.py): a slow or
+    deadline-expired request's flight-ring timeline is promoted to
+    trace.jsonl even with RBT_TRACE=0."""
     obs_metrics.REGISTRY.observe(
         "serve_request_duration_seconds", now - req._submitted,
         reason=req.finish_reason or "stop",
         help_text="End-to-end request latency (submit to finish).")
+    obs_flight.tail_sample(req.request_id, now - req._submitted,
+                           req.finish_reason or "stop")
 
 
 class EngineOverloaded(RuntimeError):
@@ -463,6 +470,22 @@ class InferenceEngine:
         obs_device.SENTINEL.install()
         self.warmup_census: Optional[dict] = None
         self._marked_steady = False  # one steady claim per engine
+        # Deterministic engine-step fault injection
+        # (docs/fault-tolerance.md): RBT_FAULT_INJECT=engine:K makes
+        # step() raise EngineStepFailed once, at decode step K — the
+        # serving worker's crash handler (doom futures, incident
+        # capture, reset) is exercisable without a real XLA failure.
+        # Parsed once here, not per step: the hot loop must not pay an
+        # env read per chunk.
+        self._fault_step: Optional[int] = None
+        fault = os.environ.get("RBT_FAULT_INJECT", "")
+        if fault.startswith("engine:"):
+            try:
+                self._fault_step = int(fault.split(":", 1)[1])
+            except ValueError as exc:
+                raise ValueError(
+                    f"RBT_FAULT_INJECT={fault!r}: expected engine:K") \
+                    from exc
         self._init_programs()
 
     def _init_cache(self) -> None:
@@ -923,7 +946,7 @@ class InferenceEngine:
                 req._admitted - req._submitted,
                 help_text="Admission-queue wait (submit to slot "
                           "assignment).")
-            if trace_enabled():
+            if record_enabled():
                 # The queue phase ends here; backdated complete event so
                 # the request's trace shows queue -> prefill -> decode.
                 trace_complete("queue_wait",
@@ -1000,7 +1023,7 @@ class InferenceEngine:
         # the decode span's active count: no per-dispatch list builds on
         # the hot path for a disabled tracer).
         attrs = ({"request_ids": [r.request_id for _, r in group]}
-                 if trace_enabled() else {})
+                 if record_enabled() else {})
         with span("prefill", bucket=bucket, rows=rows, prefix=plen,
                   **attrs), \
                 self._mesh_ctx():
@@ -1083,6 +1106,18 @@ class InferenceEngine:
         paged engine releases the slot's page references here and adopts
         its completed pages into the radix tree (serve/paging.py)."""
 
+    def _maybe_inject_fault(self) -> None:
+        """RBT_FAULT_INJECT=engine:K hook, called at the top of step()
+        (both the dense and paged variants): raise EngineStepFailed once
+        when the configured step is reached, exactly like a poisoned
+        jitted call would surface. One-shot — after the worker's crash
+        handler reset()s, the engine serves normally again."""
+        if self._fault_step is not None and self.steps >= self._fault_step:
+            self._fault_step = None
+            raise EngineStepFailed(
+                f"RBT_FAULT_INJECT: simulated engine step failure at "
+                f"step {self.steps}")
+
     def _expire_deadlines(self) -> List[int]:
         """Finish requests whose wall-clock deadline passed (between decode
         chunks — a dispatched chunk is never interrupted). Queued requests
@@ -1151,7 +1186,7 @@ class InferenceEngine:
         """Decode-span attrs, computed only when tracing is on: span()
         itself is a no-op when off, but eager kwargs would still charge
         the decode hot loop an array reduction per chunk."""
-        if not trace_enabled():
+        if not record_enabled():
             return {}
         return {"active": int(self.active.sum()),
                 "request_ids": [self.slot_req[i].request_id
@@ -1178,6 +1213,7 @@ class InferenceEngine:
         forward steps in a single jit call). Returns the number of tokens
         generated across slots (== active-slot count when chunk=1 and
         nothing finishes mid-chunk)."""
+        self._maybe_inject_fault()
         self._admit(exclude_slots=self._expire_deadlines())
         if not self.active.any():
             return 0

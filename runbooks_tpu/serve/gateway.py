@@ -51,7 +51,15 @@ import urllib.request
 from bisect import bisect_left
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from runbooks_tpu.obs import flight as obs_flight
 from runbooks_tpu.obs import metrics as obs_metrics
+from runbooks_tpu.obs.trace import (
+    instant,
+    mint_traceparent,
+    record_enabled,
+    request_scope,
+    span,
+)
 
 GATEWAY_PORT = 8080
 
@@ -503,6 +511,10 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
     router = Router(targets, policy=policy, registry=registry,
                     session_affinity=session_affinity)
     poller = MetricsPoller(router, discover=discover)
+    # Flight/trace identity: gateway spans land in THIS process's ring
+    # (and trace file), labeled as the routing tier — `rbt trace`
+    # stitches them with the replicas' rings by request id.
+    obs_flight.set_component("gateway")
     app = web.Application()
     app["router"] = router
     app["poller"] = poller
@@ -535,7 +547,43 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                 prompt = ""
         return text_blocks(prompt, block_chars)
 
+    def _trace_event(kind: str) -> bool:
+        """Count one gateway trace span/instant (only when it actually
+        records somewhere) and say whether to record it."""
+        if not record_enabled():
+            return False
+        reg.inc("gateway_trace_spans_total", kind=kind,
+                help_text="Gateway trace events recorded into the "
+                          "flight ring / trace file, by kind.")
+        return True
+
     async def _proxy(request, chat: bool):
+        """Request-scope wrapper: mint/sanitize the request id (the
+        same contract as serve/api.py — a client-omitted X-Request-Id is
+        generated here, so ONE id stitches gateway and replica), proxy,
+        then emit the gateway access-log line: one line per proxied
+        request with the chosen replica, retry count, upstream status,
+        and proxy latency — same grep-by-rid format as the serve tier's."""
+        rid, tp_out = request_scope(request.headers)
+        if tp_out is None:
+            # No client trace context: mint a root traceparent so the
+            # upstream hop still carries a stitchable W3C context.
+            tp_out = mint_traceparent()
+        t0 = time.monotonic()
+        hop = {"backend": "-", "retries": 0, "upstream_status": "-"}
+        resp = await _proxy_scoped(request, chat, rid, tp_out, hop)
+        if not getattr(resp, "prepared", False):
+            resp.headers.setdefault("X-Request-Id", rid)
+            resp.headers.setdefault("traceparent", tp_out)
+        print(f"gateway: access {request.path} rid={rid} "
+              f"status={getattr(resp, 'status', 200)} "
+              f"dur_ms={(time.monotonic() - t0) * 1000:.1f} "
+              f"backend={hop['backend']} retries={hop['retries']} "
+              f"upstream={hop['upstream_status']}", flush=True)
+        return resp
+
+    async def _proxy_scoped(request, chat: bool, rid: str, tp_out: str,
+                            hop: dict):
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -550,6 +598,11 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                     help_text="Requests carrying a session key "
                               "(X-Session-Id or user).")
         candidates = router.pick(blocks, session_key)
+        if _trace_event("route"):
+            instant("route_decision", request_id=rid,
+                    backend=candidates[0][0] if candidates else "-",
+                    reason=candidates[0][1] if candidates else "none",
+                    candidates=len(candidates))
         if not candidates:
             return web.json_response(
                 {"error": {"message": "no healthy replica",
@@ -594,23 +647,51 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                         help_text="Requests actually routed to their "
                                   "session ring owner.")
             router.inflight_add(name, 1)
+            hop["backend"] = name
+            if i:
+                hop["retries"] = i
             t_hop = time.perf_counter()
+            # Hop stitching: the SAME request id rides upstream (the
+            # replica accepts X-Request-Id verbatim), and the child
+            # traceparent carries the W3C context — one id, one trace,
+            # gateway span + replica spans.
+            fwd_headers = {"X-Request-Id": rid, "traceparent": tp_out}
+            proxy_span = (span("proxy", request_id=rid, backend=name,
+                               reason=reason, hop=i)
+                          if _trace_event("proxy") else None)
+            resp = None
+            # One finally owns the hop's cleanup (span exit, response
+            # release, inflight decrement) so EVERY exit — success,
+            # failover continue, and a client disconnect cancelling the
+            # handler mid-await — restores the counter; a leaked
+            # increment would permanently bias routing away from a
+            # healthy replica.
             try:
-                timeout = ClientTimeout(total=remaining if remaining
-                                        else 600)
-                resp = await app["client"].post(
-                    url + request.path, json=body, timeout=timeout)
-            except (ClientError, asyncio.TimeoutError) as exc:
-                router.inflight_add(name, -1)
-                router.mark_unreachable(name)
-                reg.inc("gateway_retries_total", reason="unreachable",
-                        help_text="Failovers to the next-ranked replica, "
-                                  "by cause.")
-                last_status, last_body = 502, {"error": {
-                    "message": f"replica {name} unreachable: {exc}",
-                    "type": "unreachable"}}
-                continue
-            try:
+                if proxy_span is not None:
+                    proxy_span.__enter__()
+                try:
+                    timeout = ClientTimeout(total=remaining if remaining
+                                            else 600)
+                    resp = await app["client"].post(
+                        url + request.path, json=body, timeout=timeout,
+                        headers=fwd_headers)
+                except (ClientError, asyncio.TimeoutError) as exc:
+                    if proxy_span is not None:
+                        proxy_span.__exit__(type(exc), exc, None)
+                        proxy_span = None
+                    router.mark_unreachable(name)
+                    reg.inc("gateway_retries_total", reason="unreachable",
+                            help_text="Failovers to the next-ranked "
+                                      "replica, by cause.")
+                    if _trace_event("retry"):
+                        instant("failover", request_id=rid, backend=name,
+                                reason="unreachable")
+                    hop["upstream_status"] = "unreachable"
+                    last_status, last_body = 502, {"error": {
+                        "message": f"replica {name} unreachable: {exc}",
+                        "type": "unreachable"}}
+                    continue
+                hop["upstream_status"] = resp.status
                 if resp.status in (429, 503) and i + 1 < len(candidates):
                     # Typed backpressure (serve/api.py): this replica is
                     # full or draining — the next one may not be.
@@ -619,9 +700,12 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                         last_body = await resp.json()
                     except Exception:  # noqa: BLE001 — non-JSON error body
                         last_body = {"error": {"message": "overloaded"}}
-                    reg.inc("gateway_retries_total",
-                            reason="overloaded" if resp.status == 429
-                            else "draining")
+                    retry_reason = ("overloaded" if resp.status == 429
+                                    else "draining")
+                    reg.inc("gateway_retries_total", reason=retry_reason)
+                    if _trace_event("retry"):
+                        instant("failover", request_id=rid, backend=name,
+                                reason=retry_reason)
                     continue
                 if resp.status < 400:
                     # Only a served request proves the prefix landed in
@@ -633,6 +717,7 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                 for h in ("X-Request-Id", "traceparent", "Retry-After"):
                     if h in resp.headers:
                         headers[h] = resp.headers[h]
+                headers.setdefault("X-Request-Id", rid)
                 if ctype.startswith("text/event-stream"):
                     out = web.StreamResponse(
                         status=resp.status,
@@ -655,7 +740,10 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                               "per backend.")
                 return out
             finally:
-                resp.release()
+                if proxy_span is not None:
+                    proxy_span.__exit__(None, None, None)
+                if resp is not None:
+                    resp.release()
                 router.inflight_add(name, -1)
         return web.json_response(
             last_body, status=last_status,
@@ -726,13 +814,34 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
 
     async def metrics(request):
         router.export_gauges()
+        reg.set_gauge("flight_ring_events",
+                      obs_flight.RING.stats()["events"],
+                      help_text="Events currently held in the in-memory "
+                                "flight-recorder ring.")
         return web.Response(body=reg.render().encode("utf-8"),
                             headers={"Content-Type":
                                      obs_metrics.CONTENT_TYPE})
 
+    async def debug_flight(request):
+        """GET /debug/flight[?request_id=]: the gateway's own flight
+        ring (route decisions, proxy spans, failovers) plus the current
+        backend map — `rbt trace` follows ``replicas`` to fetch each
+        backend's ring and merge one gateway→replica timeline."""
+        rid = request.query.get("request_id")
+        with router._lock:
+            replicas = {r.name: r.url
+                        for r in router._replicas.values()}
+        return web.json_response({
+            **obs_flight.identity(),
+            "stats": obs_flight.RING.stats(),
+            "replicas": replicas,
+            "events": obs_flight.RING.snapshot(request_id=rid or None),
+        })
+
     app.router.add_get("/", root)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/flight", debug_flight)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/prefix", register_prefix)

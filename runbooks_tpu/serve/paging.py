@@ -64,7 +64,7 @@ from runbooks_tpu.models.transformer import KVCache, forward
 from runbooks_tpu.obs import device as obs_device
 from runbooks_tpu.obs import metrics as obs_metrics
 from runbooks_tpu.obs.trace import complete as trace_complete
-from runbooks_tpu.obs.trace import span, trace_enabled
+from runbooks_tpu.obs.trace import record_enabled, span
 from runbooks_tpu.ops.sampling import sample
 from runbooks_tpu.serve.engine import (
     EngineStepFailed,
@@ -975,7 +975,7 @@ class PagedInferenceEngine(InferenceEngine):
                 req._admitted - req._submitted,
                 help_text="Admission-queue wait (submit to slot "
                           "assignment).")
-            if trace_enabled():
+            if record_enabled():
                 trace_complete("queue_wait",
                                req._admitted - req._submitted,
                                request_id=req.request_id, slot=slot)
@@ -1042,7 +1042,7 @@ class PagedInferenceEngine(InferenceEngine):
                            jnp.asarray(prefix_len))
         t_dispatch = time.perf_counter()
         attrs = ({"request_ids": [r.request_id for _, r in group]}
-                 if trace_enabled() else {})
+                 if record_enabled() else {})
         with span("prefill", bucket=bucket, rows=rows,
                   prefix=ppb * ps, **attrs), \
                 self._mesh_ctx():
@@ -1086,6 +1086,7 @@ class PagedInferenceEngine(InferenceEngine):
         host. Operand assembly and the chunk replay are the dense
         engine's shared helpers; only the dispatch differs (page-table
         operand, page-bucketed view)."""
+        self._maybe_inject_fault()
         self._admit(exclude_slots=self._expire_deadlines())
         if not self.active.any():
             return 0

@@ -246,10 +246,16 @@ def run_training(job: TrainJobConfig,
     optimizer = make_optimizer(job.optimizer)
     artifacts = job.artifacts_dir or contract.artifacts_dir()
     os.makedirs(artifacts, exist_ok=True)
-    if obs_trace.trace_enabled():
-        # Trace spans (RBT_TRACE=1) land next to the run's other
-        # artifacts; loadable in Perfetto mid-run (docs/observability.md).
-        obs_trace.configure(os.path.join(artifacts, "trace.jsonl"))
+    # Flight/trace identity (obs/flight.py): this run's span events —
+    # in the always-on ring, in tail-sampled promotions, and in any
+    # incident bundle — label as the training tier.
+    from runbooks_tpu.obs import flight as obs_flight
+
+    obs_flight.set_component("train")
+    # The trace path is configured unconditionally: RBT_TRACE=1 writes
+    # live spans there, and tail-sampling/incident promotion needs the
+    # same per-run destination even when live tracing is off.
+    obs_trace.configure(os.path.join(artifacts, "trace.jsonl"))
     # Persistent compile cache in the durable artifacts mount: a restarted
     # Job (slice restart / resume) skips the full XLA recompile.
     from runbooks_tpu.utils.jax_cache import enable_compilation_cache
@@ -408,6 +414,16 @@ def run_training(job: TrainJobConfig,
         print(json.dumps({"step": step_idx + 1, "nonfinite": True,
                           "consecutive_bad": bad_streak}), flush=True)
         if bad_streak >= max(1, job.max_bad_steps):
+            # The abort is an incident: bundle the flight ring, metrics,
+            # and memory/program census beside the artifacts BEFORE
+            # raising (debounced; capture never raises).
+            from runbooks_tpu.obs import incident as obs_incident
+
+            obs_incident.capture(
+                "train_max_bad_steps", artifacts=artifacts,
+                component="train",
+                extra={"step": step_idx + 1, "bad_streak": bad_streak,
+                       "nonfinite_steps": nonfinite_steps})
             raise RuntimeError(
                 f"aborting: {bad_streak} consecutive non-finite loss/grad "
                 f"steps (last at step {step_idx + 1}). Params were left "
@@ -730,10 +746,11 @@ def run_training(job: TrainJobConfig,
         finally:
             for sig, old in restore_sigs:
                 signal.signal(sig, old)
-            if obs_trace.trace_enabled():
-                # Flush the run's trace file (the writer reopens in
-                # append mode if anything traces after this).
-                obs_trace.close()
+            # Flush the run's trace file — live spans (RBT_TRACE=1) or
+            # tail-sampled/incident promotions may have opened it (the
+            # writer reopens in append mode if anything traces after
+            # this).
+            obs_trace.close()
 
     if profiling or profiling_at:  # profile window ran past the last step
         PROFILER.stop()

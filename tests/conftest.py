@@ -40,8 +40,18 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import functools  # noqa: E402
+import tempfile  # noqa: E402
 
 import pytest  # noqa: E402
+
+# Keep the container contract's /content out of test runs: always-on
+# paths (flight-recorder tail sampling, incident capture) default their
+# output under contract.artifacts_dir(), and a test that exercises them
+# without monkeypatching RBT_CONTENT_DIR must land in a throwaway dir,
+# never in a real /content (tests may run as root, where the mkdir
+# would succeed).
+os.environ.setdefault(
+    "RBT_CONTENT_DIR", tempfile.mkdtemp(prefix="rbt-test-content-"))
 
 
 @functools.lru_cache(maxsize=None)

@@ -259,8 +259,12 @@ def test_trace_disabled_is_noop(tmp_path, monkeypatch):
         obs_trace.instant("y")
     finally:
         obs_trace.configure(None)
+    # RBT_TRACE off: nothing reaches the FILE (events still land in the
+    # always-on flight ring, obs/flight.py).
     assert not os.path.exists(tmp_path / "off.jsonl")
-    # The disabled path hands back a shared null context (no allocation).
+    # With the flight recorder ALSO off, the span path hands back a
+    # shared null context (no allocation at all).
+    monkeypatch.setenv("RBT_FLIGHT", "0")
     assert obs_trace.span("a") is obs_trace.span("b")
 
 
