@@ -162,6 +162,16 @@ RBT_BENCH_SKIP_SERVE=1 run train-obs-overhead \
 RBT_BENCH_SKIP_SERVE=1 run serve-flight-overhead \
   env RBT_BENCH_FLIGHT=1 RBT_BENCH_GATE_STRICT=1 python bench.py
 
+# 4b16. Fleet history rings (docs/observability.md "Fleet history"):
+#       the per-tick append+rollup tax the scraper now pays on the REAL
+#       scrape path — 4 fake replicas over live HTTP, history-on vs
+#       no-op-history sweeps plus the deterministic per-replica ingest
+#       microbench. Acceptance: append share < 1% of scrape wall, zero
+#       unexpected XLA compiles, /metrics/history response bounded
+#       (strict mode exits 6 on any miss).
+RBT_BENCH_SKIP_SERVE=1 run fleet-history-overhead \
+  env RBT_BENCH_HISTORY=1 RBT_BENCH_GATE_STRICT=1 python bench.py
+
 # 4b2. Device-level observability (docs/observability.md): zero
 #      unexpected XLA compiles across the steady-state step loop (the
 #      compile sentinel armed after the compile-folding first step;

@@ -56,3 +56,22 @@ REASON_SLO_QUEUE_WAIT = "QueueWaitP90AboveTarget"
 REASON_SLO_ERROR_RATE = "ErrorRateAboveTarget"
 REASON_SLO_MET = "AllObjectivesMet"
 REASON_SLO_NO_DATA = "NoTelemetry"
+
+# Multi-window burn-rate reasons (controller/burnrate.py,
+# docs/observability.md "Error budgets & burn rates"): once the fleet
+# history is warm the SLOViolated reason names BOTH the objective and
+# the window pair that fired — e.g. "TTFTP99BurnRateFast5m" (severe,
+# current: burn >= 14.4x over 5m AND 1h) vs "ErrorRateBurnRateSlow30m"
+# (sustained simmer: burn >= 6x over 30m AND 6h). The instant-threshold
+# reasons above remain the cold-history fallback.
+SLO_BURN_TOKENS = {
+    "ttftP99Ms": "TTFTP99",
+    "queueWaitP90Ms": "QueueWaitP90",
+    "errorRatePct": "ErrorRate",
+}
+
+
+def slo_burn_reason(objective_key: str, window_token: str) -> str:
+    """Condition reason for a fired burn-rate window, e.g.
+    ('ttftP99Ms', 'Fast5m') -> 'TTFTP99BurnRateFast5m'."""
+    return f"{SLO_BURN_TOKENS[objective_key]}BurnRate{window_token}"

@@ -159,6 +159,16 @@ def telemetry_summary(obj: dict) -> str:
             parts.append(f"ttft99={t['ttftP99Ms']}ms")
         if "tokensPerSec" in t:
             parts.append(f"tok/s={t['tokensPerSec']}")
+        if "burnRate" in t:
+            parts.append(f"burn={t['burnRate']}x")
+        if ko.deep_get(obj, "spec", "slo", default=None):
+            # Error-budget remaining (controller/burnrate.py): present
+            # once the fleet history is warm enough to account the
+            # trailing budget window; "-" until then.
+            budget = t.get("errorBudgetRemainingPct")
+            parts.append(f"budget={budget:g}%"
+                         if isinstance(budget, (int, float))
+                         else "budget=-")
     if "replicasUp" in t and "replicas" in t:
         parts.append(f"up={t['replicasUp']}/{t['replicas']}")
     # Last-incident age from .status.lastIncident (controller-side
@@ -1063,6 +1073,180 @@ def cmd_top(args) -> int:
             pf.stop()
 
 
+# ---------------------------------------------------------------------------
+# rbt dash — terminal dashboard over the controller's /metrics/history
+# ---------------------------------------------------------------------------
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[Optional[float]], width: int = 48) -> str:
+    """Unicode sparkline over the last `width` points; None (no data in
+    that grid cell — staleness gaps, pre-warm cells) renders as '·' so
+    gaps stay visible instead of interpolating away."""
+    pts = values[-width:]
+    nums = [v for v in pts if v is not None]
+    if not nums:
+        return ""
+    lo, hi = min(nums), max(nums)
+    out = []
+    for v in pts:
+        if v is None:
+            out.append("·")
+        elif hi <= lo:
+            out.append(_SPARK_BLOCKS[3])
+        else:
+            idx = round((v - lo) / (hi - lo) * (len(_SPARK_BLOCKS) - 1))
+            out.append(_SPARK_BLOCKS[max(0, min(idx, 7))])
+    return "".join(out)
+
+
+def _fetch_history_series(url: str, names: List[str], sel: dict,
+                          since: float, step: float, q=None, agg=None):
+    """GET /metrics/history for `names`; {name: series-entry} or {} when
+    the endpoint has nothing for them."""
+    from urllib.parse import urlencode
+
+    params = {"series": ",".join(names), "since": since, "step": step}
+    if q is not None:
+        params["q"] = q
+    if agg is not None:
+        params["agg"] = agg
+    params.update(sel)
+    body = _fetch_json(url.rstrip("/") + "/metrics/history?"
+                       + urlencode(params))
+    return {s["name"]: s for s in body.get("series", [])}
+
+
+# (label, series, q, agg, scale, unit). None series = computed panel.
+_DASH_PANELS = (
+    ("ttft p99", "serve_ttft_seconds", 0.99, None, 1000.0, "ms"),
+    ("queue-wait p90", "serve_queue_wait_seconds", 0.90, None, 1000.0,
+     "ms"),
+    ("tokens/sec", "fleet_tokens_per_sec", None, "sum", 1.0, "tok/s"),
+    ("kv occupancy", "serve_kv_occupancy_ratio", None, "avg", 100.0, "%"),
+    ("hbm headroom", "device_memory_headroom_bytes", None, "sum",
+     1.0 / 2**30, "GiB"),
+    ("error rate", None, None, None, 1.0, "%"),
+    ("replicas up", "fleet_scrape_up", None, "sum", 1.0, ""),
+    ("burn rate 5m", "controller_slo_burn_rate", None, "max", 1.0, "x"),
+)
+
+
+def _dash_panel_values(url: str, sel: dict, since: float,
+                       step: float) -> List[tuple]:
+    """[(label, unit, values)] per panel — values aligned to the history
+    grid, scaled to display units."""
+    out = []
+    for label, series, q, agg, scale, unit in _DASH_PANELS:
+        if series is None:
+            # error rate %: failed-rate / request-rate, pointwise over
+            # the same grid (both counters arrive as per-second rates).
+            fetched = _fetch_history_series(
+                url, ["serve_requests_total",
+                      "serve_requests_failed_total"], sel, since, step)
+            total = (fetched.get("serve_requests_total")
+                     or {}).get("points", [])
+            failed = (fetched.get("serve_requests_failed_total")
+                      or {}).get("points", [])
+            fmap = {t: v for t, v in failed}
+            values = [None if v is None or not v
+                      else min(100.0, (fmap.get(t) or 0.0) / v * 100.0)
+                      for t, v in total]
+        else:
+            fsel = dict(sel)
+            if series == "controller_slo_burn_rate":
+                fsel["window"] = "5m"
+            elif series == "fleet_scrape_up":
+                # Serving replicas only: gateway pods scrape into the
+                # same workload key but are the data plane, not
+                # capacity (docs/serving-dataplane.md).
+                fsel["role"] = "run"
+            entry = _fetch_history_series(url, [series], fsel, since,
+                                          step, q=q, agg=agg).get(series)
+            values = [None if v is None else v * scale
+                      for _, v in (entry or {}).get("points", [])]
+        out.append((label, unit, values))
+    return out
+
+
+def _dash_rows(panels: List[tuple], width: int) -> List[List[str]]:
+    rows = []
+    for label, unit, values in panels:
+        nums = [v for v in values if v is not None]
+        if not nums:
+            rows.append([label, "(no data)", "-", ""])
+            continue
+        cur = next(v for v in reversed(values) if v is not None)
+        rows.append([label, _sparkline(values, width),
+                     f"{cur:.4g}{unit}",
+                     f"min {min(nums):.4g} max {max(nums):.4g}"])
+    return rows
+
+
+def cmd_dash(args) -> int:
+    """Live terminal dashboard from the controller's fleet history
+    (docs/observability.md "Fleet history"): unicode sparklines for the
+    serving trends — TTFT p99, queue-wait p90, tok/s, KV occupancy, HBM
+    headroom, error rate, replica count, SLO burn rate — without
+    deploying Prometheus/Grafana. Point --url at the controller's
+    metrics endpoint (or export RBT_CONTROLLER_URL); an optional
+    servers/<name> scope filters to one Server's series."""
+    url = args.url or os.environ.get("RBT_CONTROLLER_URL")
+    if not url:
+        raise SystemExit(
+            "usage: rbt dash [servers/<name>] --url CONTROLLER_URL\n"
+            "(the controller metrics endpoint serves /metrics/history — "
+            "port-forward it, e.g. kubectl port-forward deploy/"
+            "controller-manager 8080:8080 — or export "
+            "RBT_CONTROLLER_URL)")
+    scope_label = "fleet"
+    sel = {}
+    if args.scope:
+        kind, name = parse_scope(args.scope)
+        if kind != "Server" or not name:
+            raise SystemExit(
+                "usage: rbt dash [servers/<name>] [--url URL]")
+        sel = {"name": name, "namespace": args.namespace}
+        scope_label = f"servers/{name}"
+    try:
+        idx = _fetch_json(url.rstrip("/") + "/metrics/history")
+    except (OSError, ValueError) as e:
+        print(f"dash: history endpoint unreachable at {url}: {e}",
+              file=sys.stderr)
+        return 1
+    cfg = idx.get("config", {})
+    window = args.window or cfg.get("raw_retention_s", 900.0)
+    # One grid cell per sparkline column by default, so the TREND spans
+    # the whole advertised window (a finer step would silently render
+    # only its newest `width` cells).
+    step = args.step or max(cfg.get("raw_step_s", 10.0),
+                            window / max(args.width, 1))
+    header = ["PANEL", "TREND", "NOW", "RANGE"]
+    try:
+        while True:
+            try:
+                panels = _dash_panel_values(url, sel, window, step)
+            except (OSError, ValueError) as e:
+                if args.once:
+                    print(f"dash: history fetch failed: {e}",
+                          file=sys.stderr)
+                    return 1
+                panels = []
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(f"{time.strftime('%H:%M:%S')} {scope_label} dashboard "
+                  f"(step {step:g}s, window {window:g}s"
+                  + (")" if args.once else "; ctrl-c to exit)"))
+            print_table(_dash_rows(panels, args.width) or
+                        [["(none)", "", "", ""]], header)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_logs(args) -> int:
     """Stream logs of an object's workload pods (the reference TUI streams
     these inline — internal/tui/pods.go; here it shells to kubectl with the
@@ -1303,6 +1487,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="print one snapshot and exit")
     sp.add_argument("--timeout", type=float, default=720.0)
     sp.set_defaults(func=cmd_top)
+
+    sp = sub.add_parser("dash",
+                        help="live sparkline dashboard from the "
+                             "controller's fleet history")
+    sp.add_argument("scope", nargs="?", default="",
+                    help="servers/<name> to scope the panels to one "
+                         "Server")
+    sp.add_argument("--url",
+                    help="controller metrics URL (serves "
+                         "/metrics/history); or env RBT_CONTROLLER_URL")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval seconds (default 2)")
+    sp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (scripting)")
+    sp.add_argument("--window", type=float,
+                    help="lookback seconds (default: raw retention)")
+    sp.add_argument("--step", type=float,
+                    help="grid step seconds (default: raw scrape step)")
+    sp.add_argument("--width", type=int, default=48,
+                    help="sparkline width in cells (default 48)")
+    sp.set_defaults(func=cmd_dash)
 
     sp = sub.add_parser(
         "trace",

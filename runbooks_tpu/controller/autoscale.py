@@ -8,7 +8,12 @@ replica count between ``minReplicas`` and ``maxReplicas``:
 
 - **scale out** on sustained queue-wait p90 above target (the explicit
   ``queueWaitP90Ms`` knob, defaulting to ``spec.slo.queueWaitP90Ms``) or
-  a sustained SLOViolated condition — one replica per action;
+  a sustained SLOViolated condition — one replica per action. Since the
+  fleet history (obs/history.py) the p90 the reconciler passes in is the
+  REAL windowed quantile over the scale-out sustain window (stale
+  replicas excluded) once the rings are warm, not the cumulative
+  since-replica-start estimate — the sustain clock below only re-arms
+  between steps;
 - **scale in** on sustained idle capacity: queue empty AND the fleet's
   active slots would fit in one fewer replica at ``scaleInOccupancy``
   (default 0.5) of per-replica slot capacity;

@@ -1,0 +1,195 @@
+"""Multi-window multi-burn-rate SLO evaluation over the fleet history.
+
+SRE-workbook-style alerting (ch. 5, "Alerting on SLOs") replacing the
+instant-threshold evaluation of ``Server.spec.slo``: each objective gets
+an implicit **error budget** — the fraction of events allowed to be bad
+(1% for a p99 latency target, 10% for a p90 target, ``target/100`` for
+an error-rate target) — and the **burn rate** is how many times faster
+than budget the fleet is consuming it over a trailing window
+(burn 1.0 = exactly on budget; burn 14.4 = the whole budget gone in
+1/14.4 of the period).
+
+Two window pairs fire the ``SLOViolated`` condition:
+
+- **fast** — burn >= 14.4 over BOTH 5 m and 1 h: a severe, current
+  problem (pages in minutes, self-arms against one-scrape blips because
+  the 1 h window must agree);
+- **slow** — burn >= 6 over BOTH 30 m and 6 h: a sustained simmer that
+  would exhaust the budget well before a (notional) 30-day period ends.
+
+Both-windows-must-agree is also the shed rule: the condition clears when
+the short window goes quiet (the long one alone cannot hold an alert
+after recovery — that is the workbook's reset-time argument for pairing
+a short window with each long one).
+
+The math runs on EXACT windowed bucket deltas from
+:mod:`runbooks_tpu.obs.history` — the in-process equivalent of PromQL's
+``histogram_quantile(rate(..._bucket[W]))`` / ``increase()`` (the
+PromQL twins are in docs/observability.md). A window whose history is
+not yet warm is simply not computable; the Server reconciler falls back
+to the PR-6 instant-threshold check per objective until it is, so a
+fresh controller still alerts (just without window semantics), and a
+restored snapshot (controller restart, leader failover) resumes burn
+evaluation immediately without re-firing debounced onsets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from runbooks_tpu.api import conditions as cond
+
+# (token, short_window_s, long_window_s, burn threshold). Thresholds are
+# the SRE-workbook recommendations for a 30-day budget period.
+FAST_WINDOW = ("Fast5m", 300.0, 3600.0, 14.4)
+SLOW_WINDOW = ("Slow30m", 1800.0, 21600.0, 6.0)
+WINDOW_PAIRS = (FAST_WINDOW, SLOW_WINDOW)
+
+# Every distinct window, labeled as it appears on the
+# controller_slo_burn_rate{window=} gauge and in `rbt dash`.
+GAUGE_WINDOWS = (("5m", 300.0), ("30m", 1800.0),
+                 ("1h", 3600.0), ("6h", 21600.0))
+
+# The budget accountant's period: the rollup retention (6 h rolling) —
+# the longest window the in-memory history can answer exactly.
+BUDGET_WINDOW_S = 21600.0
+
+# objective spec key -> (histogram family, allowed bad fraction).
+# A p99 target concedes 1% of events, a p90 target 10%.
+LATENCY_OBJECTIVES = {
+    "ttftP99Ms": ("serve_ttft_seconds", 0.01),
+    "queueWaitP90Ms": ("serve_queue_wait_seconds", 0.10),
+}
+
+
+@dataclasses.dataclass
+class ObjectiveVerdict:
+    """One objective's burn evaluation against the history."""
+    key: str                       # spec.slo key, e.g. "ttftP99Ms"
+    target: float
+    computable: bool               # at least one window pair evaluated
+    fired: Optional[str] = None    # "Fast5m" | "Slow30m" | None
+    reason: Optional[str] = None   # window-named condition reason
+    detail: str = ""
+    burn: Dict[str, float] = dataclasses.field(default_factory=dict)
+    budget_remaining_pct: Optional[float] = None
+
+
+def _latency_burn(history, family: str, budget_frac: float,
+                  target_s: float, window_s: float, now: float,
+                  sel: dict, partial: bool = False) -> Optional[float]:
+    wh = history.window_histogram(family, window_s, now=now,
+                                  partial=partial, sel=sel)
+    if wh is None:
+        return None
+    return wh.fraction_above(target_s) / budget_frac
+
+
+def _error_burn(history, budget_frac: float, window_s: float, now: float,
+                sel: dict, partial: bool = False) -> Optional[float]:
+    total = history.window_increase("serve_requests_total", window_s,
+                                    now=now, partial=partial, sel=sel)
+    if total is None:
+        return None
+    if total <= 0:
+        return 0.0
+    failed = history.window_increase("serve_requests_failed_total",
+                                     window_s, now=now, partial=partial,
+                                     sel=sel) or 0.0
+    return (failed / total) / budget_frac
+
+
+def _objective_burn(history, key: str, target: float, window_s: float,
+                    now: float, sel: dict,
+                    partial: bool = False) -> Optional[float]:
+    """Burn rate of one objective over one window, or None when the
+    history cannot answer that window yet."""
+    if key in LATENCY_OBJECTIVES:
+        family, frac = LATENCY_OBJECTIVES[key]
+        return _latency_burn(history, family, frac, target / 1000.0,
+                             window_s, now, sel, partial)
+    if key == "errorRatePct":
+        frac = target / 100.0
+        if frac <= 0:
+            return None
+        return _error_burn(history, frac, window_s, now, sel, partial)
+    return None
+
+
+def _budget_remaining(history, key: str, target: float, now: float,
+                      sel: dict) -> Optional[float]:
+    """Percent of the objective's error budget left over the trailing
+    budget window (partial history allowed — 'over what we can see').
+    100 when the window saw no traffic; None before any history."""
+    if key in LATENCY_OBJECTIVES:
+        family, frac = LATENCY_OBJECTIVES[key]
+        wh = history.window_histogram(family, BUDGET_WINDOW_S, now=now,
+                                      partial=True, sel=sel)
+        if wh is None or wh.span_s <= 0:
+            # No history, or a single point (nothing to delta against):
+            # not warm yet — callers render "-" rather than a made-up
+            # 100%.
+            return None
+        if wh.count <= 0:
+            return 100.0
+        consumed = wh.fraction_above(target / 1000.0) / frac
+    elif key == "errorRatePct":
+        frac = target / 100.0
+        if frac <= 0:
+            return None
+        total = history.window_increase("serve_requests_total",
+                                        BUDGET_WINDOW_S, now=now,
+                                        partial=True, sel=sel)
+        if total is None:
+            return None
+        if total <= 0:
+            return 100.0
+        failed = history.window_increase("serve_requests_failed_total",
+                                         BUDGET_WINDOW_S, now=now,
+                                         partial=True, sel=sel) or 0.0
+        consumed = (failed / total) / frac
+    else:
+        return None
+    return max(0.0, (1.0 - consumed)) * 100.0
+
+
+def evaluate(slo: dict, history, sel: dict,
+             now: Optional[float] = None) -> List[ObjectiveVerdict]:
+    """Evaluate every objective in ``slo`` against the history rings
+    matching ``sel`` (the Server's {kind, namespace, name} labels).
+    Deterministic given the history contents and ``now`` — tests drive
+    it with synthetic timestamps."""
+    now = time.time() if now is None else now
+    out: List[ObjectiveVerdict] = []
+    for key in cond.SLO_BURN_TOKENS:
+        if key not in slo:
+            continue
+        target = float(slo[key])
+        v = ObjectiveVerdict(key=key, target=target, computable=False)
+        for label, window_s in GAUGE_WINDOWS:
+            burn = _objective_burn(history, key, target, window_s, now,
+                                   sel)
+            if burn is not None:
+                v.burn[label] = burn
+        for (token, short_s, long_s, threshold), (short_l, long_l) in zip(
+                WINDOW_PAIRS, (("5m", "1h"), ("30m", "6h"))):
+            short = v.burn.get(short_l)
+            long_ = v.burn.get(long_l)
+            if short is None or long_ is None:
+                continue
+            v.computable = True
+            if v.fired is None and short >= threshold \
+                    and long_ >= threshold:
+                v.fired = token
+                v.reason = cond.slo_burn_reason(key, token)
+                v.detail = (
+                    f"{key} burn {short:.1f}x/{long_:.1f}x over "
+                    f"{token} windows ({int(short_s // 60)}m/"
+                    f"{int(long_s // 60)}m, threshold {threshold:g}x "
+                    f"of budget, target {target:g})")
+        v.budget_remaining_pct = _budget_remaining(history, key, target,
+                                                   now, sel)
+        out.append(v)
+    return out

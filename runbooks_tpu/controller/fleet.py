@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from runbooks_tpu.api.types import API_VERSION
 from runbooks_tpu.k8s import objects as ko
+from runbooks_tpu.obs import history as obs_history
 from runbooks_tpu.obs import metrics as obs_metrics
 
 # (kind, pod label selector key) pairs the scraper discovers.
@@ -284,12 +285,42 @@ class FleetScraper:
 
     def __init__(self, ctx, state: Optional[FleetState] = None,
                  registry: Optional[obs_metrics.Registry] = None,
-                 timeout_s: float = 2.0):
+                 timeout_s: float = 2.0,
+                 history: Optional[obs_history.FleetHistory] = None,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_every_s: float = 60.0):
         self.ctx = ctx
         self.state = state if state is not None else FLEET
         self.registry = (registry if registry is not None
                          else obs_metrics.REGISTRY)
         self.timeout_s = timeout_s
+        # Resolved lazily so tests that monkeypatch obs_history.HISTORY
+        # after constructing the scraper (or before manager.run builds
+        # one) still land on the instance they expect.
+        self._history = history
+        self._snapshot_path = snapshot_path
+        self.snapshot_every_s = snapshot_every_s
+
+    @property
+    def history(self) -> obs_history.FleetHistory:
+        return (self._history if self._history is not None
+                else obs_history.HISTORY)
+
+    # -- snapshot persistence (restart + leader-failover survival) ------
+
+    def snapshot_path(self) -> str:
+        return (self._snapshot_path if self._snapshot_path is not None
+                else obs_history.default_snapshot_path())
+
+    def load_snapshot(self) -> str:
+        """Restore the history rings at (re)start — burn-rate windows
+        and `rbt dash` trends survive a controller restart or a leader
+        failover (the snapshot lives on the shared artifacts mount).
+        Corrupt/partial snapshots cold-start loudly, never raise."""
+        return self.history.load(self.snapshot_path())
+
+    def save_snapshot(self) -> bool:
+        return self.history.save(self.snapshot_path())
 
     # -- discovery ------------------------------------------------------
 
@@ -337,8 +368,22 @@ class FleetScraper:
                 ok += 1
         for replica in self.state.prune(live):
             # A vanished pod's mirrored absolute series would read as
-            # live forever; drop everything carrying its replica label.
+            # live forever; drop everything carrying its replica label —
+            # and mark its history rings stale so window quantiles stop
+            # blending a dead pod's distribution (they prune once their
+            # newest point ages out of raw retention).
             self.registry.drop_series(replica=replica)
+            self.history.mark_stale(replica=replica)
+        self.history.prune()
+        stats = self.history.stats()
+        self.registry.set_gauge(
+            "fleet_history_series", stats["series"],
+            help_text="Time-series rings held by the fleet history "
+                      "(obs/history.py).")
+        self.registry.set_gauge(
+            "fleet_history_points", stats["points"],
+            help_text="Total points across all fleet-history rings "
+                      "(raw + rollup).")
         self.registry.observe(
             "controller_fleet_scrape_seconds", time.perf_counter() - t0,
             help_text="Wall time of one fleet /metrics sweep across all "
@@ -351,17 +396,40 @@ class FleetScraper:
         role = ko.labels(pod).get("role", "run")
         prev = self.state.get_sample(key, replica)
         url = self._pod_url(pod)
+        labels = {"kind": kind, "namespace": ns, "name": name,
+                  "replica": replica}
         text = None
-        if url is not None:
+        fail_reason = None
+        if url is None:
+            # A Running pod with no IP/port to scrape is a discovery
+            # failure, not a quiet skip — it would otherwise read as a
+            # replica that simply never existed.
+            fail_reason = "no-url"
+        else:
+            t_req = time.perf_counter()
             try:
                 with urllib.request.urlopen(url,
                                             timeout=self.timeout_s) as resp:
                     text = resp.read().decode("utf-8", "replace")
-            except (OSError, ValueError):
-                text = None
+            except OSError:
+                # urllib's HTTPError/URLError and socket timeouts are
+                # all OSError subclasses: the pod was unreachable or
+                # answered non-200.
+                fail_reason = "unreachable"
+            except ValueError:
+                fail_reason = "bad-response"
+            self.registry.observe(
+                "fleet_scrape_duration_seconds",
+                time.perf_counter() - t_req,
+                help_text="Per-pod /metrics fetch wall time, success or "
+                          "failure (the sweep total is "
+                          "controller_fleet_scrape_seconds).")
+        if fail_reason is not None:
+            self.registry.inc(
+                "fleet_scrape_errors_total", reason=fail_reason,
+                help_text="Failed per-pod scrape attempts, by failure "
+                          "shape.", **labels)
         now = time.monotonic()
-        labels = {"kind": kind, "namespace": ns, "name": name,
-                  "replica": replica}
         if text is None:
             if prev is not None and prev.up:
                 print(f"fleet: scrape of {kind.lower()}s/{name} pod "
@@ -385,6 +453,17 @@ class FleetScraper:
                 # rate on the gauge would show a dead pod still serving.
                 self.registry.set_gauge("fleet_tokens_per_sec", 0.0,
                                         **labels)
+            # The history's replica-count line must drop too — a down
+            # replica is a visible 0, not a frozen 1. The extra role
+            # label (history-only) lets `rbt dash` count role=run pods
+            # without a gateway inflating the serving-replica panel.
+            wall = time.time()
+            self.history.append_scalar("fleet_scrape_up",
+                                       {**labels, "role": role}, wall,
+                                       0.0)
+            if kind == "Server":
+                self.history.append_scalar("fleet_tokens_per_sec",
+                                           labels, wall, 0.0)
             return False
 
         families = obs_metrics.parse_exposition(text)
@@ -403,24 +482,37 @@ class FleetScraper:
             replica=replica, up=True, families=families, last_success=now,
             tokens_total=tokens_total, tokens_per_sec=tokens_per_sec,
             role=role))
-        self._mirror(families, labels)
+        wall = time.time()
+        self._mirror(families, labels, wall)
         self.registry.set_gauge("fleet_scrape_up", 1, **labels)
         self.registry.set_gauge("fleet_scrape_age_seconds", 0.0, **labels)
+        # role is a history-only label (the registry gauge keeps its
+        # documented labelset): `rbt dash` counts role=run pods so a
+        # gateway pod never inflates the serving-replica panel.
+        self.history.append_scalar("fleet_scrape_up",
+                                   {**labels, "role": role}, wall, 1.0)
         if kind == "Server":
             self.registry.set_gauge(
                 "fleet_tokens_per_sec", round(tokens_per_sec, 1),
                 help_text="Completion tokens/s per replica over the last "
                           "scrape interval.", **labels)
+            self.history.append_scalar("fleet_tokens_per_sec", labels,
+                                       wall, round(tokens_per_sec, 3))
         return True
 
     def _mirror(self, families: Dict[str, obs_metrics.ParsedFamily],
-                extra: Dict[str, str]) -> None:
+                extra: Dict[str, str], wall: Optional[float] = None) -> None:
         """Re-expose a replica's serve_*/train_* families under the
         controller registry with {kind, namespace, name, replica} labels.
         Counters and gauges mirror as absolute values (set_counter /
         set_gauge); histograms mirror bucket-exactly (set_histogram), so
         PromQL over the controller endpoint sees the same distributions
-        a direct replica scrape would."""
+        a direct replica scrape would. The same families ALSO land as
+        one point each in the fleet history rings — a single bulk
+        `ingest` per replica (one lock, memoized label keys; bounded
+        < 1% of scrape wall by RBT_BENCH_HISTORY=1)."""
+        if wall is None:
+            wall = time.time()
         for fam in families.values():
             if not fam.name.startswith(MIRROR_PREFIXES):
                 continue
@@ -440,13 +532,23 @@ class FleetScraper:
                     self.registry.set_histogram(
                         fam.name, hist.bounds, hist.cumulative,
                         hist.count, hist.sum, **{**dict(lkey), **extra})
+        self.history.ingest(families, extra, wall, MIRROR_PREFIXES)
 
     # -- poll loop (manager side) --------------------------------------
 
     def run(self, stop: threading.Event,
             interval_s: float = DEFAULT_INTERVAL_S) -> None:
         """Scrape until `stop`; a failing sweep logs and retries — the
-        telemetry plane must never take the control plane with it."""
+        telemetry plane must never take the control plane with it.
+
+        The history rings restore from the last snapshot before the
+        first sweep (so a restarted controller — or the standby that
+        just took the lease — evaluates burn-rate windows immediately
+        instead of re-warming for an hour) and persist every
+        ``snapshot_every_s`` plus once on the way out. Snapshot failures
+        log and continue: persistence is a nicety, scraping is not."""
+        self.load_snapshot()
+        last_save = time.monotonic()
         while not stop.is_set():
             try:
                 self.scrape_once()
@@ -454,4 +556,9 @@ class FleetScraper:
                 print("fleet: scrape sweep failed (will retry):",
                       flush=True)
                 traceback.print_exc()
+            if self.snapshot_every_s > 0 and \
+                    time.monotonic() - last_save >= self.snapshot_every_s:
+                self.save_snapshot()
+                last_save = time.monotonic()
             stop.wait(interval_s)
+        self.save_snapshot()

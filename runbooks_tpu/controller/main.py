@@ -190,9 +190,12 @@ def main() -> int:
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
     from runbooks_tpu.controller.metrics import serve_metrics
+    from runbooks_tpu.obs.history import HISTORY
 
     metrics_port = int(os.environ.get("METRICS_PORT", "8080"))
-    serve_metrics(metrics_port)
+    # history=HISTORY also exposes GET /metrics/history — the bounded
+    # time-series endpoint `rbt dash` renders from (obs/history.py).
+    serve_metrics(metrics_port, history=HISTORY)
 
     elector = None
     if os.environ.get("LEADER_ELECT", "").lower() in ("1", "true"):

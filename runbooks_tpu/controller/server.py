@@ -287,12 +287,15 @@ class ServerReconciler:
             FLEET,
         )
         from runbooks_tpu.controller.metrics import REGISTRY
+        from runbooks_tpu.obs import history as obs_history
 
         key = ("Server", server.namespace, server.name)
         # Scale-in hygiene (the fleet scraper only prunes on its own
         # sweep cadence): drop samples for replica pods that no longer
         # exist or are terminating, so the p90 the decision reads is not
-        # biased toward dead pods' last distributions.
+        # biased toward dead pods' last distributions — and mark their
+        # history rings stale, so the windowed p90 below excludes them
+        # too.
         live = []
         for pod in ctx.client.list("v1", "Pod", namespace=server.namespace,
                                    label_selector={"server": server.name,
@@ -302,6 +305,7 @@ class ServerReconciler:
                 live.append(ko.name(pod))
         for rep in FLEET.retain(key, live):
             REGISTRY.drop_series(replica=rep)
+            obs_history.HISTORY.mark_stale(replica=rep)
 
         import os
 
@@ -319,10 +323,31 @@ class ServerReconciler:
         # current min/max bounds.
         base = (server.status.get("autoscale") or {}).get(
             "desiredReplicas") or server.spec.get("replicas", 1)
+        summary = FLEET.server_summary(server.namespace, server.name)
+        # Windowed queue-wait p90 (obs/history.py): once the history
+        # spans the scale-out sustain window, the decision reads the
+        # REAL p90 of observations inside that window — a burst that
+        # already drained cannot look "sustained" the way the instant
+        # merged p90 (cumulative since replica start) can, and stale
+        # (vanished/terminating) replicas' distributions are excluded
+        # by construction. The sustain clock stays as the re-arm
+        # mechanism; only the signal feeding it changes. Cold history
+        # keeps the instant p90.
+        if summary is not None:
+            sustain_s = float(spec.get(
+                "scaleOutSustainS",
+                autoscale_mod.DEFAULT_SCALE_OUT_SUSTAIN_S))
+            qw = obs_history.HISTORY.window_quantile(
+                "serve_queue_wait_seconds", 0.90,
+                max(sustain_s, 2.0 * interval),
+                sel={"kind": "Server", "namespace": server.namespace,
+                     "name": server.name})
+            if qw is not None:
+                summary = dict(summary,
+                               queueWaitP90Ms=round(qw * 1000.0, 1))
         desired, action = autoscale_mod.evaluate(
             (server.namespace, server.name), spec,
-            server.spec.get("slo") or {},
-            FLEET.server_summary(server.namespace, server.name),
+            server.spec.get("slo") or {}, summary,
             ko.is_condition_true(server.obj, cond.SLO_VIOLATED),
             FLEET.scrape_age(key), 2.0 * interval, base)
         if action is not None:
@@ -347,14 +372,77 @@ class ServerReconciler:
     # ------------------------------------------------------------------
 
     def _apply_telemetry_and_slo(self, ctx: Ctx, server: Server) -> bool:
+        from runbooks_tpu.controller import burnrate
         from runbooks_tpu.controller.fleet import FLEET
         from runbooks_tpu.controller.metrics import REGISTRY
+        from runbooks_tpu.obs import history as obs_history
 
         changed = False
-        summary = FLEET.server_summary(server.namespace, server.name)
-        if summary is not None and server.status.get("telemetry") != summary:
-            server.status["telemetry"] = summary
-            changed = True
+        fleet_summary = FLEET.server_summary(server.namespace, server.name)
+        slo = server.spec.get("slo") or {}
+        sel = {"kind": "Server", "namespace": server.namespace,
+               "name": server.name}
+
+        # Burn-rate evaluation over the fleet history rings
+        # (controller/burnrate.py): per-objective multi-window burn
+        # rates + error-budget accounting. verdicts is empty without
+        # spec.slo; a verdict is computable only once the history spans
+        # a full window pair (or was restored from a snapshot).
+        verdicts = []
+        burn_fields = {}
+        if slo:
+            now = time.time()
+            verdicts = burnrate.evaluate(slo, obs_history.HISTORY, sel,
+                                         now=now)
+            budgets = [v.budget_remaining_pct for v in verdicts
+                       if v.budget_remaining_pct is not None]
+            burns = [v.burn["5m"] for v in verdicts if "5m" in v.burn]
+            if budgets:
+                burn_fields["errorBudgetRemainingPct"] = round(
+                    min(budgets), 1)
+            if burns:
+                burn_fields["burnRate"] = round(max(burns), 2)
+                # The dash's burn panel reads this series from history
+                # (the scraper can't — the gauge lives in the
+                # controller's own registry, which never self-scrapes).
+                obs_history.HISTORY.append_scalar(
+                    "controller_slo_burn_rate",
+                    {**sel, "window": "5m"}, now, max(burns))
+            for v in verdicts:
+                for window, burn in v.burn.items():
+                    REGISTRY.set_gauge(
+                        "controller_slo_burn_rate", round(burn, 3),
+                        server=server.name, namespace=server.namespace,
+                        objective=v.key, window=window,
+                        help_text="Error-budget burn rate per SLO "
+                                  "objective and trailing window (1 = "
+                                  "exactly on budget).")
+                if v.budget_remaining_pct is not None:
+                    REGISTRY.set_gauge(
+                        "controller_slo_error_budget_remaining_pct",
+                        round(v.budget_remaining_pct, 1),
+                        server=server.name, namespace=server.namespace,
+                        objective=v.key,
+                        help_text="Percent of the objective's error "
+                                  "budget left over the trailing 6h "
+                                  "window.")
+
+        # No fleet summary yet (e.g. first reconcile after a restart,
+        # before the first scrape sweep) but burn fields computable from
+        # the restored rings: MERGE into the CR's published telemetry —
+        # replacing it would blank replicasUp/latency cells until the
+        # next sweep.
+        if fleet_summary is not None:
+            telemetry = dict(fleet_summary)
+        elif burn_fields:
+            telemetry = dict(server.status.get("telemetry") or {})
+        else:
+            telemetry = None
+        if telemetry is not None:
+            telemetry.update(burn_fields)
+            if server.status.get("telemetry") != telemetry:
+                server.status["telemetry"] = telemetry
+                changed = True
         # Fold a finished incident fan-out (this onset's or an earlier
         # one's — the sweep runs on a side thread) into status so
         # `.status.lastIncident` points at the latest bundles.
@@ -364,21 +452,33 @@ class ServerReconciler:
             server.status["lastIncident"] = incident
             changed = True
 
-        slo = server.spec.get("slo") or {}
         if not slo:
             return changed
-        violations = self._violations(slo, summary)
         was_violated = ko.is_condition_true(server.obj, cond.SLO_VIOLATED)
-        if summary is None:
+        if fleet_summary is not None and not fleet_summary.get("replicasUp"):
+            # Every replica unreachable: HOLD the last verdict. A total
+            # outage must not clear an active violation (the autoscaler/
+            # alert signal would vanish at the worst moment) — and the
+            # burn windows, fed by no fresh scrapes, would decay toward
+            # zero and shed exactly then. The fleet_scrape_up/age gauges
+            # carry the outage itself.
+            return changed
+        # Per-objective verdict: the burn-rate windows once computable,
+        # the PR-6 instant-threshold check as the cold-history fallback
+        # (a fresh controller must still alert while the rings warm).
+        violations = []
+        for v in verdicts:
+            if v.computable:
+                if v.fired:
+                    violations.append((v.reason, v.detail))
+            else:
+                violations.extend(self._violations(
+                    {v.key: slo[v.key]}, fleet_summary))
+        any_burn = any(v.computable for v in verdicts)
+        if fleet_summary is None and not any_burn:
             changed |= server.set_condition(
                 cond.SLO_VIOLATED, False, cond.REASON_SLO_NO_DATA,
                 "no replica telemetry scraped yet")
-        elif not summary.get("replicasUp"):
-            # Every replica unreachable: HOLD the last verdict. A total
-            # outage must not clear an active violation (the autoscaler/
-            # alert signal would vanish at the worst moment); the
-            # fleet_scrape_up/age gauges carry the outage itself.
-            return changed
         elif violations:
             reason, detail = violations[0][0], "; ".join(
                 v[1] for v in violations)
@@ -387,7 +487,10 @@ class ServerReconciler:
             if not was_violated:
                 # Counts violation ONSETS (condition False -> True), not
                 # reconciles spent violated — the rate the autoscaler and
-                # alerts want.
+                # alerts want. A controller restart that restores the
+                # history re-derives the same verdict against the same
+                # persisted condition, so it neither re-counts nor
+                # re-fires the capture below.
                 REGISTRY.inc(
                     "controller_slo_violations_total",
                     server=server.name, objective=reason,
@@ -406,8 +509,9 @@ class ServerReconciler:
                 "all objectives within target")
         REGISTRY.set_gauge(
             "fleet_slo_violated",
-            int(bool(violations)) if summary is not None
-            and summary.get("replicasUp") else 0,
+            int(bool(violations)) if any_burn or (
+                fleet_summary is not None
+                and fleet_summary.get("replicasUp")) else 0,
             kind="Server", namespace=server.namespace, name=server.name,
             help_text="1 while the Server's SLOViolated condition is "
                       "true.")

@@ -20,6 +20,9 @@ numbers.
 - ``obs.device``   — device-level: recompilation sentinel
   (``xla_unexpected_compiles_total``), HBM/live-array accounting,
   roofline (compute- vs bandwidth-bound) attribution per program.
+- ``obs.history``  — bounded fleet time-series rings (two-resolution,
+  staleness-aware, snapshot-persisted) behind windowed SLO burn-rate
+  alerting, the controller's ``GET /metrics/history``, and ``rbt dash``.
 
 See docs/observability.md for the metric catalog and how-tos.
 """

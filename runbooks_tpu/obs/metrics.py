@@ -54,6 +54,9 @@ CATALOG: Dict[str, str] = {
     "controller_slo_violations_total": "counter",
     "controller_autoscale_actions_total": "counter",
     "controller_fleet_scrape_seconds": "histogram",
+    # burn-rate SLO layer (controller/burnrate.py, obs/history.py)
+    "controller_slo_burn_rate": "gauge",
+    "controller_slo_error_budget_remaining_pct": "gauge",
     # fleet scraper (per-replica labels {kind, name, replica}; the serve_*
     # and train_* families below also appear with these labels on the
     # controller's exposition, mirrored at scrape time)
@@ -61,6 +64,11 @@ CATALOG: Dict[str, str] = {
     "fleet_scrape_age_seconds": "gauge",
     "fleet_tokens_per_sec": "gauge",
     "fleet_slo_violated": "gauge",
+    # telemetry-plane self-observability + history rings
+    "fleet_scrape_errors_total": "counter",
+    "fleet_scrape_duration_seconds": "histogram",
+    "fleet_history_series": "gauge",
+    "fleet_history_points": "gauge",
     # serve
     "serve_requests_total": "counter",
     "serve_requests_failed_total": "counter",
@@ -384,22 +392,45 @@ class Registry:
 REGISTRY = Registry()
 
 
-def serve_metrics(port: int, registry: Optional[Registry] = None) -> HTTPServer:
+def serve_metrics(port: int, registry: Optional[Registry] = None,
+                  history=None) -> HTTPServer:
     """Serve GET /metrics on a background thread (controller-manager's
     metrics endpoint; reference: controller-runtime --metrics-bind-address).
     port=0 binds an ephemeral port (tests); read it back from
-    ``httpd.server_address``."""
+    ``httpd.server_address``.
+
+    With ``history`` (an obs/history.py FleetHistory — the controller
+    passes the process-wide HISTORY) the endpoint also answers
+    ``GET /metrics/history[?series=&since=&step=&q=&agg=&<label>=...]``:
+    bounded JSON time series resampled from the fleet rings — the data
+    plane behind ``rbt dash`` (docs/observability.md "Fleet history")."""
+    import json as _json
+    from urllib.parse import parse_qs, urlparse
+
     reg = registry if registry is not None else REGISTRY
 
     class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, body: bytes, content_type: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802
-            if self.path == "/metrics":
-                body = reg.render().encode("utf-8")
-                self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            parsed = urlparse(self.path)
+            if parsed.path == "/metrics":
+                self._send(200, reg.render().encode("utf-8"), CONTENT_TYPE)
+            elif parsed.path == "/metrics/history" and history is not None:
+                try:
+                    payload = history.http_query(parse_qs(parsed.query))
+                except ValueError as e:
+                    self._send(400, _json.dumps(
+                        {"error": str(e)}).encode("utf-8"),
+                        "application/json")
+                    return
+                self._send(200, _json.dumps(payload).encode("utf-8"),
+                           "application/json")
             else:
                 self.send_response(404)
                 self.end_headers()

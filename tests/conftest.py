@@ -90,3 +90,18 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _reset_fleet_history():
+    """The fleet history (obs/history.py HISTORY) is process-wide state
+    written by every FleetScraper sweep and read by the burn-rate SLO
+    evaluator and the autoscaler's windowed p90 — one test's appended
+    rings must never leak a computable window into another test's
+    reconciles (the windows key off REAL wall-clock time, so leakage
+    would be order- and wall-time-dependent flakiness)."""
+    from runbooks_tpu.obs.history import HISTORY
+
+    HISTORY.reset()
+    yield
+    HISTORY.reset()
