@@ -144,6 +144,16 @@ run serve-paged env RBT_BENCH_PAGED=1 python bench_serve.py
 run serve-router env RBT_BENCH_ROUTER=1 python bench_serve.py
 run gateway-smoke python tools/gateway_smoke.py 3
 
+# 4a4. Speculative decoding (docs/speculative-decoding.md): greedy
+#      decode tok/s per accept-rate bucket (~0/~50/~90% via the
+#      controlled-accuracy drafter over the REAL batched verify path,
+#      plus the real n-gram drafter's measured rate on repetitive
+#      traffic), spec-on vs spec-off at equal batch — value is the
+#      speedup at the high-accept bucket (acceptance >= 1.5x,
+#      vs_baseline = speedup/1.5, forced to 0 on any unexpected
+#      compile), with token-for-token greedy parity asserted inline.
+run serve-spec env RBT_BENCH_SPEC=1 python bench_serve.py
+
 # 4b. Observability instrumentation overhead (docs/observability.md):
 #     the per-step cost of the obs subsystem (spans + histogram observes +
 #     goodput update) as a percent of the real step time, PLUS the fleet-

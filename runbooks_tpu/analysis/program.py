@@ -63,6 +63,10 @@ class AuditSettings:
     config: str = "debug"
     max_slots: int = 2
     decode_chunk: int = 2
+    # Speculative verify window (serve/engine.py make_verify_fn): the
+    # audit traces the verify factories at this K — the max reachable
+    # shape, matching the backend default (utils/hw.backend_tuning).
+    draft_tokens: int = 4
     batch: int = 2
     seq: int = 64
     f32_upcast_bytes: int = 1 << 20   # 1 MiB
@@ -216,6 +220,7 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         make_decode_fn,
         make_prefill_fn,
         make_prefix_build_fn,
+        make_verify_fn,
         view_buckets_for,
     )
     import jax
@@ -274,6 +279,18 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
 
     rest = prefill_args(rep_rows, rep_bucket, plen=rep_plen)
 
+    # Speculative verify (serve/engine.py make_verify_fn): audited at
+    # max K (settings.draft_tokens) and the widest row set — one
+    # compiled program per decode view, same census shape as decode.
+    K = settings.draft_tokens
+    verify = make_verify_fn(cfg, K, max_seq_len, views[-1])
+    verify_args = [params, pool,
+                   _sds((slots, K + 1), jnp.int32),
+                   _sds((slots,), jnp.int32), _sds((slots,), jnp.int32),
+                   key, _sds((slots,), jnp.float32),
+                   _sds((slots,), jnp.int32), _sds((slots,), jnp.float32),
+                   _sds((slots,), jnp.bool_)]
+
     # Paged engine (serve/paging.py): same audit discipline — the paged
     # factories are the bodies the paged engine jits, traced at their
     # most complex reachable shape (largest prefix-page bucket splice;
@@ -283,6 +300,7 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         PagePool,
         make_paged_decode_fn,
         make_paged_prefill_fn,
+        make_paged_verify_fn,
         paged_prefill_shapes,
         view_page_buckets_for,
     )
@@ -316,6 +334,14 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         _sds((slots,), jnp.float32), _sds((slots,), jnp.int32),
         _sds((slots,), jnp.float32), _sds((slots,), jnp.int32),
         _sds((slots,), jnp.int32), _sds((slots,), jnp.bool_)]
+    paged_verify = make_paged_verify_fn(cfg, K, page_size,
+                                        vp_buckets[-1], pool_pages)
+    paged_verify_args = [
+        params, paged_pool, _sds((slots, mpps), jnp.int32),
+        _sds((slots, K + 1), jnp.int32),
+        _sds((slots,), jnp.int32), _sds((slots,), jnp.int32), key,
+        _sds((slots,), jnp.float32), _sds((slots,), jnp.int32),
+        _sds((slots,), jnp.float32), _sds((slots,), jnp.bool_)]
 
     return [
         {"component": "serve", "name": "prefill", "fn": prefill,
@@ -336,6 +362,11 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
          "signatures": len(pshapes) * len(rows_set)},
         {"component": "serve", "name": "paged_decode",
          "fn": paged_decode, "args": paged_decode_args,
+         "signatures": len(vp_buckets)},
+        {"component": "serve", "name": "verify", "fn": verify,
+         "args": verify_args, "signatures": len(views)},
+        {"component": "serve", "name": "paged_verify",
+         "fn": paged_verify, "args": paged_verify_args,
          "signatures": len(vp_buckets)},
     ]
 
@@ -437,6 +468,7 @@ def audit_programs(
         "settings": {"config": settings.config,
                      "max_slots": settings.max_slots,
                      "decode_chunk": settings.decode_chunk,
+                     "draft_tokens": settings.draft_tokens,
                      "batch": settings.batch, "seq": settings.seq},
         "programs": programs,
     }

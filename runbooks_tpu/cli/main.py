@@ -986,6 +986,14 @@ def _top_detail(families, kind: str, sel: dict) -> str:
             parts.append(f"ttft99={ttft:.1f}ms")
         if tps is not None:
             parts.append(f"tok/s={tps:g}")
+        # Speculative-decoding accept rate (docs/speculative-decoding.md):
+        # the serve_spec_* families exist only when speculation is on, so
+        # the cell appears exactly for speculative replicas.
+        drafted = _metric_value(families, "serve_spec_drafted_total", sel)
+        if drafted:
+            accepted = _metric_value(families,
+                                     "serve_spec_accepted_total", sel) or 0
+            parts.append(f"acc={accepted / drafted * 100:.0f}%")
         # Last-incident age (obs/incident.py): the series exists only
         # once the replica captured a bundle — absence means "never".
         inc_age = _metric_value(families, "serve_incident_age_seconds",

@@ -81,6 +81,10 @@ ENUM_PARAMS = {
     # value would otherwise silently serve the dense slot pool.
     **{k: ("off", "paged") for k in ("kv_paging", "kvPaging",
                                      "kvpaging")},
+    # Speculative decoding (serve/engine.py verify path,
+    # docs/speculative-decoding.md): a typo'd value would otherwise
+    # silently serve without drafting.
+    "speculative": ("off", "ngram"),
     **{k: _ACCUM_ENUM for k in _ACCUM_KEYS},
     **{k: _CM_ENUM for k in _CM_KEYS},
 }
@@ -100,6 +104,19 @@ DEFAULT_PREEMPTION_RESTARTS = 2
 # condition.
 _MAX_BAD_STEPS_KEYS = ("max_bad_steps", "maxBadSteps", "maxbadsteps")
 
+# Speculative-decoding knobs (serve/engine.py, docs/speculative-
+# decoding.md), accepted under the usual three spellings. The defaults
+# mirror ModelConfig.ngram_max/ngram_min (keep in sync, like
+# DEFAULT_TRAIN_BATCH_SIZE): the min<=max cross-check must hold against
+# the default the engine will actually use when the spec sets only one
+# side, or a lone `ngram_min: 5` passes here and crash-loops every
+# replica at engine construction.
+_DRAFT_TOKENS_KEYS = ("draft_tokens", "draftTokens", "drafttokens")
+_NGRAM_MAX_KEYS = ("ngram_max", "ngramMax", "ngrammax")
+_NGRAM_MIN_KEYS = ("ngram_min", "ngramMin", "ngrammin")
+DEFAULT_NGRAM_MAX = 3
+DEFAULT_NGRAM_MIN = 1
+
 INT_PARAMS = {
     "loss_chunk": 0,
     "prefetch_depth": 0,
@@ -117,6 +134,11 @@ INT_PARAMS = {
     "num_pages": 1,
     **{k: 1 for k in ("numPages", "numpages")},
     **{k: 8 for k in ("pageSize", "pagesize")},
+    # Speculative decoding window + n-gram sizes (serve/engine.py);
+    # ngram_min <= ngram_max is cross-checked in validate_params.
+    **{k: 1 for k in _DRAFT_TOKENS_KEYS},
+    **{k: 1 for k in _NGRAM_MAX_KEYS},
+    **{k: 1 for k in _NGRAM_MIN_KEYS},
     # Consecutive non-finite steps the trainer tolerates before aborting.
     **{k: 1 for k in _MAX_BAD_STEPS_KEYS},
     **{k: 0 for k in _RESTART_KEYS},
@@ -277,6 +299,17 @@ def validate_params(params: dict) -> Optional[str]:
                 return f"spec.params.{key}: {val} must be >= {flo}"
         except (TypeError, ValueError):
             return f"spec.params.{key}: {val!r} is not a number"
+    # Speculative-decoding cross-field check (the per-key floors above
+    # already ran, so int() here cannot raise on a validated value).
+    # An omitted side compares against the engine default — the engine
+    # constructs the index (and would crash) even with speculation off.
+    ngram_max = next((params[k] for k in _NGRAM_MAX_KEYS
+                      if params.get(k) is not None), DEFAULT_NGRAM_MAX)
+    ngram_min = next((params[k] for k in _NGRAM_MIN_KEYS
+                      if params.get(k) is not None), DEFAULT_NGRAM_MIN)
+    if int(ngram_min) > int(ngram_max):
+        return (f"spec.params.ngram_min: {ngram_min} must be <= "
+                f"ngram_max {ngram_max}")
     accum = next((params[k] for k in _ACCUM_KEYS
                   if params.get(k) is not None), None)
     if accum is not None:

@@ -52,6 +52,26 @@ def resolve_collective_matmul_param(params: dict) -> Optional[str]:
     return None if val is None else check_collective_matmul(val)
 
 
+# Speculative decoding on the serve decode path (serve/engine.py,
+# docs/speculative-decoding.md): "off" | "ngram" (model-free
+# prompt-lookup drafting + one batched verify forward). Same
+# single-source-of-truth pattern as collective_matmul: the controller's
+# jax-free validation table mirrors this enum.
+SPECULATIVE_MODES = ("off", "ngram")
+
+
+def check_speculative(mode: str) -> str:
+    """Validate a speculative mode string (single source for the error
+    message — engine, serve entrypoint, and trainer-adjacent readers all
+    funnel through here)."""
+    mode = str(mode)
+    if mode not in SPECULATIVE_MODES:
+        raise ValueError(
+            f"unknown speculative {mode!r}; expected "
+            f"{'|'.join(SPECULATIVE_MODES)}")
+    return mode
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """Architecture hyperparameters for a decoder-only transformer."""
@@ -166,6 +186,27 @@ class ModelConfig:
     # scales. None = follow `quantize` (any quantized weight tier also
     # quantizes the cache); True/False force.
     quantize_kv: Optional[bool] = None
+
+    # Speculative decoding on the serve decode path
+    # (docs/speculative-decoding.md): "off" | "ngram". "ngram" turns on
+    # model-free prompt-lookup drafting — a host-side per-slot n-gram
+    # index over each request's prompt + generated tokens proposes up to
+    # `draft_tokens` continuation tokens, and one batched [B, K+1]
+    # verify forward scores them for every slot at once. Decode is
+    # HBM-bandwidth-bound, so each verified-accepted draft token is
+    # nearly free bandwidth-wise (the roofline gauge
+    # xla_program_bandwidth_bound confirms it live).
+    speculative: str = "off"
+    # Draft window K: tokens proposed (and verified) per speculative
+    # step. None = backend default (utils/hw.backend_tuning). Fixed at
+    # engine construction — K is a static program shape, never a
+    # per-request knob.
+    draft_tokens: Optional[int] = None
+    # Prompt-lookup n-gram sizes: the drafter matches the trailing
+    # n-gram of the context for n from ngram_max down to ngram_min and
+    # proposes the tokens that followed its most recent occurrence.
+    ngram_max: int = 3
+    ngram_min: int = 1
 
     # Training-time behavior. "nothing_saveable" = full remat (memory-safe
     # default); "dots_saveable" / "dots_with_no_batch_dims_saveable" save

@@ -87,6 +87,13 @@ CATALOG: Dict[str, str] = {
     "serve_request_duration_seconds": "histogram",
     "serve_prefill_dispatch_seconds": "histogram",
     "serve_decode_dispatch_seconds": "histogram",
+    # Speculative decoding (serve/engine.py verify path,
+    # docs/speculative-decoding.md): exported only when speculative is
+    # on ("off" engines register none of these)
+    "serve_spec_drafted_total": "counter",
+    "serve_spec_accepted_total": "counter",
+    "serve_spec_accept_len": "histogram",
+    "serve_verify_dispatch_seconds": "histogram",
     # trainer
     "train_step_seconds": "histogram",
     "train_data_wait_seconds": "histogram",

@@ -455,7 +455,11 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   drain_timeout_s: float = 30.0,
                   kv_paging: bool = False,
                   page_size: int = 16,
-                  num_pages: Optional[int] = None) -> web.Application:
+                  num_pages: Optional[int] = None,
+                  speculative: Optional[str] = None,
+                  draft_tokens: Optional[int] = None,
+                  ngram_max: Optional[int] = None,
+                  ngram_min: Optional[int] = None) -> web.Application:
     """max_queue bounds the admission queue (full -> HTTP 429 with
     Retry-After); request_timeout_s is the default per-request wall-clock
     deadline (body field "timeout" overrides per request; expiry finishes
@@ -468,7 +472,14 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     prefix sharing across requests, and admission gates on free pages
     instead of dense slot rows — docs/paged-kv.md covers sizing
     page_size/num_pages (default num_pages matches the dense worst-case
-    reservation)."""
+    reservation).
+
+    speculative="ngram" turns on prompt-lookup speculative decoding on
+    the decode path (docs/speculative-decoding.md): up to draft_tokens
+    tokens per slot drafted from an n-gram index (ngram_max/ngram_min)
+    over each request's own context and verified in one batched
+    forward. None = follow the model config; greedy outputs are
+    token-for-token identical with speculation on or off."""
     if not request_timeout_s:
         # 0 disables, like the other *_s knobs — a validated config of 0
         # must mean "no deadline", not "400 every deadline-less request".
@@ -482,14 +493,20 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             max_seq_len=max_seq_len, mesh=mesh,
             prefill_budget=prefill_budget, decode_chunk=decode_chunk,
             prefix_cache_size=prefix_cache_size, max_queue=max_queue,
-            page_size=page_size, num_pages=num_pages)
+            page_size=page_size, num_pages=num_pages,
+            speculative=speculative, draft_tokens=draft_tokens,
+            ngram_max=ngram_max, ngram_min=ngram_min)
     else:
         engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
                                  max_seq_len=max_seq_len, mesh=mesh,
                                  prefill_budget=prefill_budget,
                                  decode_chunk=decode_chunk,
                                  prefix_cache_size=prefix_cache_size,
-                                 max_queue=max_queue)
+                                 max_queue=max_queue,
+                                 speculative=speculative,
+                                 draft_tokens=draft_tokens,
+                                 ngram_max=ngram_max,
+                                 ngram_min=ngram_min)
     if warmup:
         # Pre-compile all buckets before readiness flips. warm_prefix
         # (params.json: warm_prefix) additionally compiles the prefix-KV
@@ -598,6 +615,20 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         reg.set_counter("serve_prefix_hits_total", eng.prefix_hits,
                         help_text="Admissions whose prompt matched a "
                                   "registered prefix.")
+        if eng.speculative != "off":
+            # Speculative decoding (serve/engine.py verify path,
+            # docs/speculative-decoding.md): draft volume vs verified
+            # acceptance — the accept rate is the whole economics of
+            # drafting, so it mirrors to the fleet with the other
+            # serve_* families. serve_spec_accept_len (histogram) is
+            # observed by the engine at replay time.
+            reg.set_counter("serve_spec_drafted_total", eng.spec_drafted,
+                            help_text="Draft tokens proposed by the "
+                                      "prompt-lookup drafter.")
+            reg.set_counter("serve_spec_accepted_total",
+                            eng.spec_accepted,
+                            help_text="Draft tokens verified-accepted "
+                                      "by the batched verify forward.")
         if occ.get("paged"):
             # Paged engine (serve/paging.py): page-pool pressure + radix
             # sharing, the per-PAGE extension of the admission-level hit
@@ -714,6 +745,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                     stats = reg.histogram_stats(
                         "serve_decode_dispatch_seconds",
                         view=entry["name"][len("decode_v"):])
+                elif entry["name"].startswith("verify_v"):
+                    stats = reg.histogram_stats(
+                        "serve_verify_dispatch_seconds",
+                        view=entry["name"][len("verify_v"):])
                 elif entry["name"] == "prefill" and sig.startswith("b"):
                     bucket, _, rows_sig = sig[1:].partition("r")
                     stats = reg.histogram_stats(
@@ -732,6 +767,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         return web.json_response({
             "programs": census,
             "warmup_census": worker.engine.warmup_census,
+            # Speculation economics (docs/speculative-decoding.md):
+            # accept rate + decode tok/s per accept-rate bucket, so the
+            # "is drafting paying on this traffic" question is one GET.
+            "speculative": worker.engine.spec_stats(),
             "compiles": {"total": sentinel.total,
                          "unexpected": sentinel.unexpected,
                          "compile_seconds": round(
@@ -1239,6 +1278,10 @@ def main() -> int:
         mesh = make_mesh(MeshConfig(**mesh_args))
 
     num_pages_raw = _param_any(params, "num_pages", "numPages", "numpages")
+    draft_raw = _param_any(params, "draft_tokens", "draftTokens",
+                           "drafttokens")
+    ngram_max_raw = _param_any(params, "ngram_max", "ngramMax", "ngrammax")
+    ngram_min_raw = _param_any(params, "ngram_min", "ngramMin", "ngrammin")
     app = create_server(
         cfg, model_params, tokenizer,
         max_slots=int(params.get("max_slots", 8)),
@@ -1269,7 +1312,16 @@ def main() -> int:
         page_size=int(_param_any(params, "page_size", "pageSize",
                                  "pagesize", default=16)),
         num_pages=(int(num_pages_raw)
-                   if num_pages_raw is not None else None))
+                   if num_pages_raw is not None else None),
+        # Speculative decoding (docs/speculative-decoding.md):
+        # `speculative: ngram` is the validated spelling (controller
+        # validate_params); the engine re-validates via
+        # check_speculative before warmup compiles anything.
+        speculative=(str(params["speculative"])
+                     if params.get("speculative") is not None else None),
+        draft_tokens=int(draft_raw) if draft_raw is not None else None,
+        ngram_max=int(ngram_max_raw) if ngram_max_raw is not None else None,
+        ngram_min=int(ngram_min_raw) if ngram_min_raw is not None else None)
     port = int(params.get("port", contract.SERVE_PORT))
 
     # Graceful drain on SIGTERM (docs/fault-tolerance.md): run_app's

@@ -53,9 +53,23 @@ from runbooks_tpu.obs import flight as obs_flight
 from runbooks_tpu.obs import metrics as obs_metrics
 from runbooks_tpu.obs.trace import complete as trace_complete
 from runbooks_tpu.obs.trace import record_enabled, span
-from runbooks_tpu.ops.sampling import sample
+from runbooks_tpu.ops.sampling import sample, speculative_verify
+from runbooks_tpu.serve.speculative import NgramDraftIndex
+from runbooks_tpu.utils.hw import backend_tuning
 
 Params = Any
+
+# Accept-length histogram buckets (tokens accepted per slot per verify
+# step): small ints up to the largest plausible draft window. Fixed so
+# the exposition stays comparable across K configurations.
+_ACCEPT_LEN_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
+
+# Per-verify-step accept-rate buckets for the host-side tok/s breakdown
+# (/debug/programs "speculative" block): each verify step's
+# accepted/drafted ratio lands in one of these, and the step's emitted
+# tokens + wall time accumulate there — decode throughput BY accept
+# rate, the number that says whether drafting pays on this traffic.
+_ACCEPT_RATE_BUCKETS = ("0-25%", "25-50%", "50-75%", "75-100%")
 
 # Inter-token gaps run from microseconds (host replay inside a decode
 # chunk) to chunk wall time; the default latency buckets start at 1 ms and
@@ -319,6 +333,44 @@ def make_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
     return decode_fn
 
 
+def make_verify_fn(cfg: ModelConfig, draft_tokens: int, pad_slot: int,
+                   view: int):
+    """One batched draft-verify forward for speculative decoding
+    (docs/speculative-decoding.md): score K drafted tokens per slot in a
+    single ``[B, K+1]`` dispatch. ``tokens[:, 0]`` is each slot's
+    carry-in token (the last sampled token, whose KV the next step owes
+    the cache anyway) and ``tokens[:, 1:1+d]`` its d proposed draft
+    tokens; rows park positions past their draft length (and inactive
+    rows entirely) at the trash slot, so a mixed batch — some slots
+    drafting K tokens, some none — runs as ONE program.
+
+    The forward writes KV for all live positions; the HOST accepts the
+    longest verified prefix per slot and rolls the write cursor back by
+    simply not advancing ``lengths`` past it — rejected-draft KV beyond
+    the cursor is rewritten by the next dispatch before anything can
+    attend it (the same stale-data invariant prefill relies on), so
+    rollback costs zero device work. Verdicts come from
+    ops/sampling.speculative_verify: greedy accepts exact argmax
+    matches; temperature sampling uses exact rejection sampling against
+    the engine's own filtered distribution, so speculation never changes
+    the output distribution."""
+    K = draft_tokens
+
+    def verify_fn(params, cache, tokens, positions, draft_len, rng,
+                  temperature, top_k, top_p, active):
+        offs = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+        live = active[:, None] & (offs <= draft_len[:, None])
+        pos = jnp.where(live, positions[:, None] + offs, pad_slot)
+        logits, cache = forward(cfg, params, tokens, positions=pos,
+                                cache=cache, cache_view=view)
+        rng, sub = jax.random.split(rng)
+        accept, resid, full = speculative_verify(
+            logits, tokens[:, 1:], sub, temperature, top_k, top_p)
+        return accept, resid, full, cache, rng
+
+    return verify_fn
+
+
 class InferenceEngine:
     """Batched generation over a fixed slot pool. Thread-unsafe by design;
     drive it from one loop (the API server wraps it in a single worker)."""
@@ -330,7 +382,11 @@ class InferenceEngine:
                  decode_chunk: Optional[int] = None,
                  prefix_cache_size: Optional[int] = None,
                  quantize_kv: Optional[bool] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 speculative: Optional[str] = None,
+                 draft_tokens: Optional[int] = None,
+                 ngram_max: Optional[int] = None,
+                 ngram_min: Optional[int] = None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
@@ -372,15 +428,54 @@ class InferenceEngine:
         EngineOverloaded instead of growing the list without limit — at
         overload, every queued request's deadline/latency degrades
         together, so shedding with a 429 beats accepting work the engine
-        cannot serve in time. Default: max(16, 4 * max_slots)."""
+        cannot serve in time. Default: max(16, 4 * max_slots).
+
+        speculative / draft_tokens / ngram_max / ngram_min: speculative
+        decoding (docs/speculative-decoding.md). None = follow the
+        config (cfg.speculative etc.; draft_tokens then defaults via
+        utils/hw.backend_tuning). "ngram" drives the decode loop through
+        draft-then-verify: a host-side prompt-lookup index proposes up
+        to draft_tokens continuation tokens per slot and one [B, K+1]
+        verify forward scores every slot's drafts at once; steps with no
+        draft anywhere fall back to the plain decode chunk."""
         self.cfg = cfg
         self.mesh = mesh
         self.prefill_budget = prefill_budget
+        tuning = backend_tuning()
         if decode_chunk is None:
-            decode_chunk = 8 if "tpu" in jax.default_backend().lower() else 1
+            decode_chunk = tuning["decode_chunk"]
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = decode_chunk
+        from runbooks_tpu.models.config import check_speculative
+
+        self.speculative = check_speculative(
+            speculative if speculative is not None else cfg.speculative)
+        self.draft_tokens = int(
+            draft_tokens if draft_tokens is not None
+            else cfg.draft_tokens if cfg.draft_tokens is not None
+            else tuning["draft_tokens"])
+        if self.draft_tokens < 1:
+            raise ValueError(
+                f"draft_tokens must be >= 1, got {self.draft_tokens}")
+        self.ngram_max = int(ngram_max if ngram_max is not None
+                             else cfg.ngram_max)
+        self.ngram_min = int(ngram_min if ngram_min is not None
+                             else cfg.ngram_min)
+        # The index constructor validates 1 <= ngram_min <= ngram_max;
+        # probe even when speculation is off so a bad config fails at
+        # construction, not when someone flips speculative on.
+        self._spec_index: Optional[NgramDraftIndex] = NgramDraftIndex(
+            max_slots, self.ngram_max, self.ngram_min)
+        if self.speculative == "off":
+            self._spec_index = None
+        # Speculation accounting (cumulative; /metrics + spec_stats()).
+        self.spec_drafted = 0        # draft tokens proposed
+        self.spec_accepted = 0       # draft tokens verified-accepted
+        self.spec_verify_steps = 0   # verify dispatches
+        # accept-rate bucket -> [tokens emitted, dispatch seconds]
+        self._spec_rate_buckets = {b: [0, 0.0]
+                                   for b in _ACCEPT_RATE_BUCKETS}
         if mesh is not None and int(mesh.shape.get("stage", 1)) > 1:
             raise ValueError(
                 "pipeline (stage) parallelism is a training-path feature; "
@@ -541,6 +636,24 @@ class InferenceEngine:
 
         self._decode_for = decode_for
 
+        # Speculative verify programs: one [B, K+1] forward per view
+        # bucket, same lazy-jit + tracker discipline as decode (warmup
+        # compiles every view so a draft can never compile under
+        # traffic).
+        self._verify_fns: dict = {}
+
+        def verify_for(view: int):
+            if view not in self._verify_fns:
+                self._verify_fns[view] = jax.jit(
+                    make_verify_fn(cfg, self.draft_tokens, self._pad_slot,
+                                   view),
+                    donate_argnums=(1,))
+                obs_device.PROGRAMS.register("serve", f"verify_v{view}",
+                                             self._verify_fns[view])
+            return self._verify_fns[view]
+
+        self._verify_for = verify_for
+
     def _new_pool_cache(self) -> KVCache:
         """Fresh slot-pool cache (int8 + scales when quantize_kv), sharded
         under the serving mesh when one is configured."""
@@ -646,6 +759,24 @@ class InferenceEngine:
                                 self.cache, *args)
                     _, _, self.cache, _ = self._decode_for(view)(
                         self.params, self.cache, *args)
+            n_verify = 0
+            if self.speculative != "off":
+                vtok = np.zeros((self.max_slots, self.draft_tokens + 1),
+                                np.int32)
+                for view in self.view_buckets:
+                    args = (jnp.asarray(vtok), jnp.asarray(zeros),
+                            jnp.asarray(zeros), jax.random.key(0),
+                            jnp.zeros(self.max_slots, jnp.float32),
+                            jnp.zeros(self.max_slots, jnp.int32),
+                            jnp.ones(self.max_slots, jnp.float32),
+                            jnp.zeros(self.max_slots, bool))
+                    with self._mesh_ctx():
+                        record_cost(f"verify_v{view}", f"v{view}",
+                                    self._verify_for(view), self.params,
+                                    self.cache, *args)
+                        _, _, _, self.cache, _ = self._verify_for(view)(
+                            self.params, self.cache, *args)
+                    n_verify += 1
         # Compiled-program census from the tracker (count + names +
         # compile seconds): model-config variants (collective_matmul,
         # quantized tiers) multiply the per-shape program set, and a
@@ -659,6 +790,9 @@ class InferenceEngine:
             "rows": row_set,
             "decode_views": list(self.view_buckets),
             "prefix_builders": n_prefix,
+            "verify_programs": n_verify,
+            "speculative": self.speculative,
+            "draft_tokens": self.draft_tokens,
             "compiles": sentinel.total - compiles_before,
             "compile_seconds": round(
                 sentinel.compile_seconds - seconds_before, 3),
@@ -670,7 +804,8 @@ class InferenceEngine:
             f"serve: warmup census: {n_prefill} prefill programs "
             f"({len(self.prefill_buckets)} buckets {self.prefill_buckets} "
             f"x rows {row_set}), {len(self.view_buckets)} decode views "
-            f"{self.view_buckets}, {n_prefix} prefix builders; "
+            f"{self.view_buckets}, {n_prefix} prefix builders, "
+            f"{n_verify} verify programs; "
             f"{self.warmup_census['compiles']} compiles in "
             f"{self.warmup_census['compile_seconds']}s "
             f"({[(c['name'], c['programs']) for c in census]})",
@@ -886,6 +1021,8 @@ class InferenceEngine:
         self.last_token[:] = 0
         self.slot_req = [None] * self.max_slots
         self.queue.clear()
+        if self._spec_index is not None:
+            self._spec_index.reset()
 
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any())
@@ -1052,18 +1189,29 @@ class InferenceEngine:
             help_text="Prefill dispatch+sync wall time per admission "
                       "group, labeled by prompt bucket and row count.")
         for i, (slot, req) in enumerate(group):
-            tok = int(first[i])
-            self.active[slot] = True
-            self.lengths[slot] = len(req.prompt_tokens)
-            self.last_token[slot] = tok
-            self.slot_req[slot] = req
-            req._slot = slot
-            self._record_token(slot, tok)
+            self._activate_slot(slot, req, int(first[i]))
+
+    def _activate_slot(self, slot: int, req: Request,
+                       first_tok: int) -> None:
+        """Post-prefill slot activation, shared with the paged engine:
+        bookkeeping, the speculative draft index's context start, and
+        the first token's recording (which may immediately finish a
+        max_tokens=1 request)."""
+        self.active[slot] = True
+        self.lengths[slot] = len(req.prompt_tokens)
+        self.last_token[slot] = first_tok
+        self.slot_req[slot] = req
+        req._slot = slot
+        if self._spec_index is not None:
+            self._spec_index.begin(slot, req.prompt_tokens)
+        self._record_token(slot, first_tok)
 
     def _record_token(self, slot: int, tok: int) -> None:
         req = self.slot_req[slot]
         assert req is not None
         req.output_tokens.append(tok)
+        if self._spec_index is not None:
+            self._spec_index.extend(slot, tok)
         # Latency histograms, host-observed: TTFT on the first token,
         # inter-token gaps after. Chunked decode replays a chunk's tokens
         # in one host loop, so within-chunk gaps are microseconds and the
@@ -1101,10 +1249,13 @@ class InferenceEngine:
     def _on_slot_finished(self, slot: int, req: Request) -> None:
         """Called once per slot whose request just finished (normal stop,
         length, or deadline expiry), after the slot's bookkeeping is
-        cleared but before the slot can be re-admitted. No-op for the
-        dense pool (the slot's cache rows simply get overwritten); the
-        paged engine releases the slot's page references here and adopts
-        its completed pages into the radix tree (serve/paging.py)."""
+        cleared but before the slot can be re-admitted. The dense pool
+        needs no cache work (the slot's rows simply get overwritten);
+        the paged engine additionally releases the slot's page
+        references and adopts its completed pages into the radix tree
+        (serve/paging.py, which calls super())."""
+        if self._spec_index is not None:
+            self._spec_index.clear(slot)
 
     def _maybe_inject_fault(self) -> None:
         """RBT_FAULT_INJECT=engine:K hook, called at the top of step()
@@ -1209,14 +1360,180 @@ class InferenceEngine:
         return generated
 
     def step(self) -> int:
-        """Admit queued requests, run one decode chunk (`decode_chunk`
-        forward steps in a single jit call). Returns the number of tokens
-        generated across slots (== active-slot count when chunk=1 and
-        nothing finishes mid-chunk)."""
+        """Admit queued requests, then advance every active slot: one
+        speculative verify forward when drafting is on and any slot has
+        a draft (no-draft slots ride the same batch and advance one
+        token), otherwise one decode chunk (`decode_chunk` forward steps
+        in a single jit call). Returns the number of tokens generated
+        across slots."""
         self._maybe_inject_fault()
         self._admit(exclude_slots=self._expire_deadlines())
         if not self.active.any():
             return 0
+        generated: Optional[int] = None
+        if self._spec_index is not None:
+            drafts = self._collect_drafts()
+            if drafts is not None:
+                generated = self._verify_step(drafts)
+        if generated is None:
+            generated = self._decode_chunk_step()
+        self.steps += 1
+        return generated
+
+    # -- speculative decoding (docs/speculative-decoding.md) -----------
+
+    def _draft_for(self, slot: int, max_tokens: int) -> List[int]:
+        """Draft proposal for one slot (<= max_tokens tokens). The
+        default source is the prompt-lookup n-gram index; overridable so
+        benches/tests can substitute a controlled-accuracy oracle while
+        exercising the REAL verify path."""
+        return self._spec_index.draft(slot, max_tokens)
+
+    def _collect_drafts(self) -> Optional[dict]:
+        """Per-active-slot draft proposals, capped so a verify step can
+        never overrun a request's token budget (emitting <= d+1 tokens
+        must fit in max_tokens) or write past the context window (the
+        verify forward writes positions L..L+d, which must stay below
+        the trash slot). None when no slot proposes anything — the
+        caller then runs the plain decode chunk, so draft-less traffic
+        keeps its full chunk amortization."""
+        K = self.draft_tokens
+        drafts: dict = {}
+        any_draft = False
+        for slot in range(self.max_slots):
+            if not self.active[slot]:
+                continue
+            req = self.slot_req[slot]
+            cap = min(K,
+                      self.max_seq_len - 1 - int(self.lengths[slot]),
+                      req.max_tokens - len(req.output_tokens) - 1)
+            d = self._draft_for(slot, cap) if cap >= 1 else []
+            drafts[slot] = [int(t) for t in d[:max(cap, 0)]]
+            any_draft = any_draft or bool(drafts[slot])
+        return drafts if any_draft else None
+
+    def _verify_step(self, drafts: dict) -> int:
+        """One batched draft-verify step: assemble the [B, K+1] operands
+        (carry-in token + per-slot drafts), dispatch the verify program,
+        and replay each slot's verdict on the host — accept the longest
+        verified prefix, emit its correction/bonus token, and advance
+        the KV cursor (`lengths`) only past what was accepted. Rejected
+        tokens' KV stays as garbage beyond the cursor and is rewritten
+        by the next dispatch before anything can attend it, so rollback
+        is free (dense: scatter cursor; paged: in-page cursor — shared
+        pages are structurally out of write range either way)."""
+        B, K = self.max_slots, self.draft_tokens
+        tokens = np.zeros((B, K + 1), np.int32)
+        draft_len = np.zeros(B, np.int32)
+        for slot, d in drafts.items():
+            tokens[slot, 0] = self.last_token[slot]
+            if d:
+                tokens[slot, 1:1 + len(d)] = d
+                draft_len[slot] = len(d)
+        positions = np.where(self.active, self.lengths, 0).astype(np.int32)
+        temps, top_ks, top_ps, _eos, _rem = self._sampling_operands()
+        step_drafted = int(draft_len.sum())
+        t_dispatch = time.perf_counter()
+        accept, resid, full = self._verify_dispatch(
+            tokens, positions, draft_len, temps, top_ks, top_ps)
+        wall = time.perf_counter() - t_dispatch
+        generated = 0
+        step_accepted = 0
+        reg = obs_metrics.REGISTRY
+        for slot, d in drafts.items():
+            if not self.active[slot] or self.slot_req[slot] is None:
+                continue
+            nd = len(d)
+            a = 0
+            while a < nd and bool(accept[slot, a]):
+                a += 1
+            # Accepted drafts, then the model's own next token: the
+            # residual correction at the first rejection, or the bonus
+            # sample after a clean sweep (nd == 0 degenerates to a plain
+            # one-token decode for this slot).
+            emitted = d[:a] + [int(resid[slot, a]) if a < nd
+                               else int(full[slot, nd])]
+            if nd:
+                self.spec_drafted += nd
+                self.spec_accepted += a
+                step_accepted += a
+                reg.observe("serve_spec_accept_len", float(a),
+                            buckets=_ACCEPT_LEN_BUCKETS,
+                            help_text="Draft tokens accepted per slot "
+                                      "per verify step.")
+            for tok in emitted:
+                if not self.active[slot]:
+                    break  # EOS / budget / room finished mid-replay
+                generated += 1
+                self.lengths[slot] += 1
+                self.last_token[slot] = tok
+                self._record_token(slot, tok)
+        self.spec_verify_steps += 1
+        if step_drafted:
+            rate = step_accepted / step_drafted
+            idx = min(int(rate * 4), 3)
+            bucket = self._spec_rate_buckets[_ACCEPT_RATE_BUCKETS[idx]]
+            bucket[0] += generated
+            bucket[1] += wall
+        return generated
+
+    def _verify_dispatch(self, tokens, positions, draft_len, temps,
+                         top_ks, top_ps):
+        """Run the dense verify program at the smallest view bucket
+        covering every position this step can write (L + K), returning
+        host verdict arrays."""
+        view = self._view_for(int(self.lengths[self.active].max())
+                              + self.draft_tokens + 1)
+        t_dispatch = time.perf_counter()
+        with span("verify", view=view, drafted=int(draft_len.sum()),
+                  **self._decode_span_attrs()), self._mesh_ctx():
+            accept, resid, full, self.cache, self.rng = \
+                self._verify_for(view)(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(draft_len),
+                    self.rng, jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(self.active))
+            # rbt-check: ignore[device-sync] verify dispatch boundary: one sync per verify step, not per token
+            accept = np.asarray(accept)
+            # rbt-check: ignore[device-sync] same boundary — resid rides the same verify sync
+            resid = np.asarray(resid)
+            # rbt-check: ignore[device-sync] same boundary — full rides the same verify sync
+            full = np.asarray(full)
+        obs_metrics.REGISTRY.observe(
+            "serve_verify_dispatch_seconds",
+            time.perf_counter() - t_dispatch, view=str(view),
+            help_text="Speculative verify dispatch+sync wall time, "
+                      "labeled by cache view bucket.")
+        return accept, resid, full
+
+    def spec_stats(self) -> dict:
+        """Speculation effectiveness snapshot (/debug/programs): draft
+        volume, accept rate, and decode tok/s per accept-rate bucket —
+        the host-side join that says whether drafting pays on THIS
+        traffic (docs/speculative-decoding.md)."""
+        out = {"mode": self.speculative}
+        if self.speculative == "off":
+            return out
+        out.update({
+            "draft_tokens": self.draft_tokens,
+            "ngram_max": self.ngram_max,
+            "ngram_min": self.ngram_min,
+            "drafted_total": self.spec_drafted,
+            "accepted_total": self.spec_accepted,
+            "accept_rate": (round(self.spec_accepted / self.spec_drafted,
+                                  4) if self.spec_drafted else None),
+            "verify_steps": self.spec_verify_steps,
+            "tokens_per_sec_by_accept_rate": {
+                name: {"tokens": tok, "seconds": round(sec, 6),
+                       "tokens_per_sec": (round(tok / sec, 1)
+                                          if sec > 0 else None)}
+                for name, (tok, sec) in self._spec_rate_buckets.items()},
+        })
+        return out
+
+    def _decode_chunk_step(self) -> int:
+        """One plain decode chunk over every active slot (the
+        pre-speculation hot path, unchanged)."""
         # Inactive rows decode into the trash slot at a harmless position;
         # mid-chunk, rows that finish are parked there by the device mask.
         positions = np.where(self.active, self.lengths,
@@ -1242,9 +1559,7 @@ class InferenceEngine:
             time.perf_counter() - t_dispatch, view=str(view),
             help_text="Decode-chunk dispatch+sync wall time, labeled by "
                       "cache view bucket.")
-        generated = self._replay_chunk(toks, valid)
-        self.steps += 1
-        return generated
+        return self._replay_chunk(toks, valid)
 
     # ------------------------------------------------------------------
     # Convenience synchronous generation

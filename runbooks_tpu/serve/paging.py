@@ -65,7 +65,7 @@ from runbooks_tpu.obs import device as obs_device
 from runbooks_tpu.obs import metrics as obs_metrics
 from runbooks_tpu.obs.trace import complete as trace_complete
 from runbooks_tpu.obs.trace import record_enabled, span
-from runbooks_tpu.ops.sampling import sample
+from runbooks_tpu.ops.sampling import sample, speculative_verify
 from runbooks_tpu.serve.engine import (
     EngineStepFailed,
     InferenceEngine,
@@ -564,6 +564,87 @@ def make_paged_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
     return paged_decode_fn
 
 
+def make_paged_verify_fn(cfg: ModelConfig, draft_tokens: int,
+                         page_size: int, view_pages: int, num_pages: int):
+    """Speculative draft-verify over paged KV: one ``[B, K+1]`` forward
+    (carry-in token + up to K drafts per slot) against the gathered
+    contiguous view, with every live position's K/V scattered back to
+    its physical page (docs/speculative-decoding.md). The host rolls
+    back rejected tokens by not advancing the slot's in-page cursor —
+    a shared page is never a write target (live positions are >= the
+    prompt length, past every shared full-prompt page), so rollback can
+    never touch, free, or corrupt a radix/CoW page. Verdict semantics
+    are the dense ``make_verify_fn``'s exactly
+    (ops/sampling.speculative_verify)."""
+    K = draft_tokens
+    n_flat = (num_pages + 1) * page_size
+    trash_flat = num_pages * page_size
+    V = view_pages * page_size
+    L, kvh, d = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+
+    def paged_verify_fn(params, pool, page_tables, tokens, positions,
+                        draft_len, rng, temperature, top_k, top_p,
+                        active):
+        quantized = pool.k.dtype == jnp.int8
+        flat_k = pool.k.reshape(L, n_flat, kvh, d)
+        flat_v = pool.v.reshape(L, n_flat, kvh, d)
+        flat_ks = (pool.k_scale.reshape(L, n_flat, kvh)
+                   if quantized else None)
+        flat_vs = (pool.v_scale.reshape(L, n_flat, kvh)
+                   if quantized else None)
+        t = jnp.arange(V, dtype=jnp.int32)
+        fidx = (page_tables[:, t // page_size] * page_size
+                + t % page_size)                             # [B, V]
+        pad5 = [(0, 0), (0, 0), (0, 1), (0, 0), (0, 0)]
+        view_cache = KVCache(
+            k=jnp.pad(flat_k[:, fidx], pad5),
+            v=jnp.pad(flat_v[:, fidx], pad5),
+            index=jnp.zeros((), jnp.int32),
+            k_scale=(jnp.pad(flat_ks[:, fidx], pad5[:-1])
+                     if quantized else None),
+            v_scale=(jnp.pad(flat_vs[:, fidx], pad5[:-1])
+                     if quantized else None))
+        offs = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+        live = active[:, None] & (offs <= draft_len[:, None])
+        # Park dead lanes at the view trash slot V (the padded row the
+        # gather appended) — same parking the paged decode scan uses.
+        pos = jnp.where(live, positions[:, None] + offs, V)
+        logits, vc = forward(cfg, params, tokens, positions=pos,
+                             cache=view_cache)
+        # Write-back: every live position's freshly written K/V, view ->
+        # physical page; parked lanes land in the pool trash page.
+        idx5 = pos[None, :, :, None, None]
+        wk = jnp.take_along_axis(vc.k, idx5, axis=2)   # [L, B, K+1, kvh, d]
+        wv = jnp.take_along_axis(vc.v, idx5, axis=2)
+        page = jnp.take_along_axis(
+            page_tables,
+            jnp.clip(pos // page_size, 0, page_tables.shape[1] - 1),
+            axis=1)                                    # [B, K+1]
+        fi = jnp.where(live, page * page_size + pos % page_size,
+                       trash_flat)
+        flat_k = flat_k.at[:, fi].set(wk)
+        flat_v = flat_v.at[:, fi].set(wv)
+        if quantized:
+            i4 = pos[None, :, :, None]
+            wks = jnp.take_along_axis(vc.k_scale, i4, axis=2)
+            wvs = jnp.take_along_axis(vc.v_scale, i4, axis=2)
+            flat_ks = flat_ks.at[:, fi].set(wks)
+            flat_vs = flat_vs.at[:, fi].set(wvs)
+        rng, sub = jax.random.split(rng)
+        accept, resid, full = speculative_verify(
+            logits, tokens[:, 1:], sub, temperature, top_k, top_p)
+        new_pool = PagePool(
+            k=flat_k.reshape(pool.k.shape),
+            v=flat_v.reshape(pool.v.shape),
+            k_scale=(flat_ks.reshape(pool.k_scale.shape)
+                     if quantized else None),
+            v_scale=(flat_vs.reshape(pool.v_scale.shape)
+                     if quantized else None))
+        return accept, resid, full, new_pool, rng
+
+    return paged_verify_fn
+
+
 # ---------------------------------------------------------------------------
 # Host-side paging state
 # ---------------------------------------------------------------------------
@@ -726,6 +807,8 @@ class PagedInferenceEngine(InferenceEngine):
         self.last_token[:] = 0
         self.slot_req = [None] * self.max_slots
         self.queue.clear()
+        if self._spec_index is not None:
+            self._spec_index.reset()
 
     # -- programs ------------------------------------------------------
 
@@ -755,6 +838,21 @@ class PagedInferenceEngine(InferenceEngine):
             return self._decode_fns[view_pages]
 
         self._decode_for = decode_for
+        self._verify_fns: dict = {}
+
+        def verify_for(view_pages: int):
+            if view_pages not in self._verify_fns:
+                self._verify_fns[view_pages] = jax.jit(
+                    make_paged_verify_fn(cfg, self.draft_tokens,
+                                         self.page_size, view_pages,
+                                         self.num_pages),
+                    donate_argnums=(1,))
+                obs_device.PROGRAMS.register(
+                    "serve", f"verify_p{view_pages}",
+                    self._verify_fns[view_pages])
+            return self._verify_fns[view_pages]
+
+        self._verify_for = verify_for
 
     def _view_pages_for(self, max_pos: int) -> int:
         """Smallest view-page bucket whose token extent covers every
@@ -838,6 +936,24 @@ class PagedInferenceEngine(InferenceEngine):
                             self.cache, *args)
                 _, _, self.cache, _ = self._decode_for(vp)(
                     self.params, self.cache, *args)
+            n_verify = 0
+            if self.speculative != "off":
+                vtok = np.zeros((self.max_slots, self.draft_tokens + 1),
+                                np.int32)
+                for vp in self.view_page_buckets:
+                    args = (jnp.asarray(tables), jnp.asarray(vtok),
+                            jnp.asarray(zeros), jnp.asarray(zeros),
+                            jax.random.key(0),
+                            jnp.zeros(self.max_slots, jnp.float32),
+                            jnp.zeros(self.max_slots, jnp.int32),
+                            jnp.ones(self.max_slots, jnp.float32),
+                            jnp.zeros(self.max_slots, bool))
+                    record_cost(f"verify_p{vp}", f"p{vp}",
+                                self._verify_for(vp), self.params,
+                                self.cache, *args)
+                    _, _, _, self.cache, _ = self._verify_for(vp)(
+                        self.params, self.cache, *args)
+                    n_verify += 1
         census = obs_device.PROGRAMS.census("serve")
         self.warmup_census = {
             "prefill_programs": n_prefill,
@@ -848,6 +964,9 @@ class PagedInferenceEngine(InferenceEngine):
             "decode_views": list(self.view_page_buckets),
             "page_size": self.page_size,
             "num_pages": self.num_pages,
+            "verify_programs": n_verify,
+            "speculative": self.speculative,
+            "draft_tokens": self.draft_tokens,
             "compiles": sentinel.total - compiles_before,
             "compile_seconds": round(
                 sentinel.compile_seconds - seconds_before, 3),
@@ -860,7 +979,8 @@ class PagedInferenceEngine(InferenceEngine):
             f"({len(shapes)} (bucket, prefix-pages) shapes x rows "
             f"{row_set}), {len(self.view_page_buckets)} decode views "
             f"(pages {self.view_page_buckets}), "
-            f"{self.num_pages}x{self.page_size} pool; "
+            f"{self.num_pages}x{self.page_size} pool, "
+            f"{n_verify} verify programs; "
             f"{self.warmup_census['compiles']} compiles in "
             f"{self.warmup_census['compile_seconds']}s", flush=True)
         if not self._marked_steady:
@@ -1057,13 +1177,7 @@ class PagedInferenceEngine(InferenceEngine):
             help_text="Prefill dispatch+sync wall time per admission "
                       "group, labeled by prompt bucket and row count.")
         for i, (slot, req) in enumerate(group):
-            tok = int(first[i])
-            self.active[slot] = True
-            self.lengths[slot] = len(req.prompt_tokens)
-            self.last_token[slot] = tok
-            self.slot_req[slot] = req
-            req._slot = slot
-            self._record_token(slot, tok)
+            self._activate_slot(slot, req, int(first[i]))
 
     # -- lifecycle hooks ----------------------------------------------
 
@@ -1078,18 +1192,48 @@ class PagedInferenceEngine(InferenceEngine):
         written = len(req.prompt_tokens) + max(0, m - 1)
         toks = (req.prompt_tokens + req.output_tokens)[:written]
         self.pager.release(slot, written_tokens=toks)
+        super()._on_slot_finished(slot, req)  # speculative index clear
 
     # -- decode --------------------------------------------------------
 
-    def step(self) -> int:
-        """Admit (page-gated), run one paged decode chunk, replay on the
-        host. Operand assembly and the chunk replay are the dense
-        engine's shared helpers; only the dispatch differs (page-table
-        operand, page-bucketed view)."""
-        self._maybe_inject_fault()
-        self._admit(exclude_slots=self._expire_deadlines())
-        if not self.active.any():
-            return 0
+    def _verify_dispatch(self, tokens, positions, draft_len, temps,
+                         top_ks, top_ps):
+        """Paged speculative verify: same verdict contract as the dense
+        dispatch, against the gathered page view (page-table operand,
+        page-bucketed view sized to cover L + K writes)."""
+        vp = self._view_pages_for(int(self.lengths[self.active].max())
+                                  + self.draft_tokens + 1)
+        t_dispatch = time.perf_counter()
+        with span("verify", view=vp * self.page_size,
+                  drafted=int(draft_len.sum()),
+                  **self._decode_span_attrs()), self._mesh_ctx():
+            accept, resid, full, self.cache, self.rng = \
+                self._verify_for(vp)(
+                    self.params, self.cache,
+                    jnp.asarray(self.pager.page_table),
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(draft_len), self.rng,
+                    jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(self.active))
+            # rbt-check: ignore[device-sync] verify dispatch boundary: one sync per verify step, not per token
+            accept = np.asarray(accept)
+            # rbt-check: ignore[device-sync] same boundary — resid rides the same verify sync
+            resid = np.asarray(resid)
+            # rbt-check: ignore[device-sync] same boundary — full rides the same verify sync
+            full = np.asarray(full)
+        obs_metrics.REGISTRY.observe(
+            "serve_verify_dispatch_seconds",
+            time.perf_counter() - t_dispatch,
+            view=str(vp * self.page_size),
+            help_text="Speculative verify dispatch+sync wall time, "
+                      "labeled by cache view bucket.")
+        return accept, resid, full
+
+    def _decode_chunk_step(self) -> int:
+        """One paged decode chunk (page-gated admission already ran in
+        the shared step()). Operand assembly and the chunk replay are
+        the dense engine's shared helpers; only the dispatch differs
+        (page-table operand, page-bucketed view)."""
         # Inactive rows decode at position 0; their writes land in the
         # trash page (free slots' page-table rows all point there).
         positions = np.where(self.active, self.lengths, 0).astype(np.int32)
@@ -1117,9 +1261,7 @@ class PagedInferenceEngine(InferenceEngine):
             view=str(vp * self.page_size),
             help_text="Decode-chunk dispatch+sync wall time, labeled by "
                       "cache view bucket.")
-        generated = self._replay_chunk(toks, valid)
-        self.steps += 1
-        return generated
+        return self._replay_chunk(toks, valid)
 
     # -- observability -------------------------------------------------
 

@@ -44,3 +44,26 @@ def chip_hbm_bandwidth(device) -> float:
         if key in kind:
             return val
     return 0.0
+
+
+def backend_tuning() -> dict:
+    """Backend-dependent serving defaults, probed in ONE place instead of
+    per-module ``"tpu" in jax.default_backend()`` sniffing (the engine's
+    decode_chunk default and the speculative-decoding defaults both used
+    to hard-code the probe).
+
+    - ``on_tpu``: whether the default JAX backend is a TPU.
+    - ``decode_chunk``: decode steps per host round-trip. 8 on TPU — a
+      per-step host sync dominates small-batch inter-token latency
+      there; 1 elsewhere (CPU dispatch is cheap and tests want
+      step-at-a-time).
+    - ``draft_tokens``: default speculative draft window K
+      (docs/speculative-decoding.md). 4 on every backend today; kept
+      here so a backend-specific retune is one edit, not a sniff hunt.
+    """
+    import jax
+
+    on_tpu = "tpu" in jax.default_backend().lower()
+    return {"on_tpu": on_tpu,
+            "decode_chunk": 8 if on_tpu else 1,
+            "draft_tokens": 4}
