@@ -36,6 +36,16 @@ routed request for both. Acceptance: prefix-aware routing reuses
 >= 1.5x the pages per request (value = uplift, vs_baseline =
 uplift / 1.5) with zero unexpected XLA compiles throughout.
 
+RBT_BENCH_LORA=1 runs the multi-tenant LoRA density axis
+(docs/multi-tenant-lora.md): N adapters on ONE pooled engine vs N
+dedicated merged-weights engines serving the same workload, reporting
+tenants-per-HBM-byte (weights + KV + pool vs N x weights + KV) and
+decode tok/s for both, with greedy token parity asserted inline (f32 —
+the runtime delta equals the load-time fold exactly) and the pool sized
+below N so the steady loop swaps adapters under the compile sentinel.
+Acceptance: >= 2x density at 4 tenants (value = uplift, vs_baseline =
+uplift / 2, zeroed on any unexpected compile).
+
 RBT_BENCH_SPEC=1 runs the speculative-decoding axis
 (docs/speculative-decoding.md): greedy decode tok/s per accept-rate
 bucket, speculation on vs off at EQUAL batch. The spec-off pass
@@ -186,6 +196,196 @@ def paged_inner() -> None:
         "pages_shared": occ["pages_shared"],
         "pages_evicted_total": occ["pages_evicted_total"],
         "unexpected_compiles_steady_loop": unexpected,
+        "platform": jax.default_backend(),
+        "device": str(device),
+    }))
+
+
+def lora_inner() -> None:
+    """Multi-tenant LoRA density: N adapters on ONE pooled engine vs N
+    dedicated merged-weights engines (docs/multi-tenant-lora.md).
+
+    Both sides serve the SAME workload — R greedy requests per tenant —
+    and the pooled outputs are asserted token-for-token identical to the
+    dedicated engines' inline (float32, where the runtime delta and the
+    load-time fold agree exactly; a corrupted gather can change
+    throughput, never content). The headline number is tenant density at
+    equal service: serving N tenants costs the dedicated fleet
+    N x (weights + KV) bytes and the pooled engine 1 x (weights + KV)
+    + pool bytes — the uplift is bytes_dedicated / bytes_pooled
+    (acceptance >= 2x at N=4).
+
+    Two pooled phases: (A) pool = N — every tenant resident after its
+    first load; the density + decode tok/s numbers, measuring the
+    grouped-matmul cost, not artifact IO. (B) pool = N/2 — the steady
+    ADAPTER-SWAPPING loop (every admission churns lanes: loads,
+    evictions, zero residency hits), whose whole point is the compile
+    sentinel staying silent; its tok/s is reported separately as the
+    thrash floor (artifact reads land in the decode loop — the
+    adapter-miss latency docs/troubleshooting.md triages)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.obs import device as obs_device
+    from runbooks_tpu.serve.engine import InferenceEngine, Request
+    from runbooks_tpu.serve.lora_pool import save_adapter
+    from runbooks_tpu.train.lora import LoraConfig, apply_lora, init_lora
+
+    device = jax.devices()[0]
+    on_tpu = ("tpu" in jax.default_backend().lower()
+              or "TPU" in str(device))
+    model = os.environ.get("RBT_BENCH_MODEL",
+                           "bench-410m" if on_tpu else "debug")
+    n_tenants = int(os.environ.get("RBT_BENCH_TENANTS", 4))
+    pool_size = int(os.environ.get("RBT_BENCH_ADAPTER_POOL",
+                                   max(2, n_tenants // 2)))
+    slots = int(os.environ.get("RBT_BENCH_SLOTS", 4))
+    max_seq = int(os.environ.get("RBT_BENCH_MAXSEQ", 128))
+    prompt_len = int(os.environ.get("RBT_BENCH_PROMPT", 32))
+    max_tokens = int(os.environ.get("RBT_BENCH_MAXTOK", 16))
+    per_tenant = int(os.environ.get("RBT_BENCH_REQUESTS", 3))
+    rank = int(os.environ.get("RBT_BENCH_LORA_RANK", 8))
+
+    # float32 end to end: the inline parity assert compares the pooled
+    # runtime delta against merged-weights engines, exact at f32.
+    cfg = get_config(model, dtype="float32", param_dtype="float32",
+                     adapter_pool=pool_size, lora_rank=rank)
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+    weight_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
+
+    tmp = tempfile.mkdtemp(prefix="rbt-lora-bench-")
+    rng = np.random.default_rng(0)
+    adapter_paths, merged = [], []
+    for i in range(n_tenants):
+        lcfg = LoraConfig(rank=rank, alpha=2.0 * rank)
+        lora = init_lora(params, lcfg, jax.random.key(100 + i))
+        lora = jax.tree.map(
+            lambda x, i=i: x + 0.02 * jax.random.normal(
+                jax.random.key(200 + i), x.shape, x.dtype), lora)
+        path = os.path.join(tmp, f"tenant{i}")
+        save_adapter(path, lora, rank=rank, alpha=2.0 * rank)
+        adapter_paths.append(path)
+        merged.append(apply_lora(params, lora, lcfg))
+
+    prompts = {i: [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+                   for _ in range(per_tenant)]
+               for i in range(n_tenants)}
+
+    def drive(engine, reqs):
+        for r in reqs:
+            engine.submit(r)
+        t0 = time.perf_counter()
+        for _ in range(200000):
+            engine.step()
+            if all(r.finished for r in reqs):
+                break
+        else:
+            raise RuntimeError("lora bench workload did not converge")
+        wall = time.perf_counter() - t0
+        return wall, sum(len(r.output_tokens) for r in reqs)
+
+    # -- dedicated fleet: one merged-weights engine per tenant ---------
+    dedicated_out = {}
+    dedicated_wall = dedicated_toks = 0.0
+    kv_bytes = None
+    for i in range(n_tenants):
+        eng = InferenceEngine(
+            get_config(model, dtype="float32", param_dtype="float32"),
+            merged[i], max_slots=slots, max_seq_len=max_seq,
+            max_queue=4 * slots * n_tenants)
+        if kv_bytes is None:
+            kv_bytes = sum(x.nbytes for x in (eng.cache.k, eng.cache.v,
+                                              eng.cache.k_scale,
+                                              eng.cache.v_scale)
+                           if x is not None)
+        eng.warmup()
+        reqs = [Request(prompt_tokens=list(p), max_tokens=max_tokens,
+                        temperature=0.0) for p in prompts[i]]
+        wall, toks = drive(eng, reqs)
+        dedicated_wall += wall
+        dedicated_toks += toks
+        dedicated_out[i] = [r.output_tokens for r in reqs]
+        eng.release_steady()
+        del eng
+
+    def pooled_run(pool_n):
+        """One pooled-engine pass over the tenant-interleaved workload
+        (heterogeneous batches by construction). Returns (wall, tokens,
+        adapter stats, unexpected compiles) with inline token parity
+        against the dedicated fleet."""
+        eng = InferenceEngine(
+            get_config(model, dtype="float32", param_dtype="float32",
+                       adapter_pool=pool_n, lora_rank=rank),
+            params, max_slots=slots, max_seq_len=max_seq,
+            max_queue=4 * slots * n_tenants)
+        pool_bytes = eng.adapters.pool_bytes()
+        eng.warmup()
+        reqs = []
+        for j in range(per_tenant):
+            for i in range(n_tenants):
+                reqs.append((i, j, Request(
+                    prompt_tokens=list(prompts[i][j]),
+                    max_tokens=max_tokens, temperature=0.0,
+                    adapter=adapter_paths[i])))
+        unexpected_before = obs_device.SENTINEL.unexpected
+        wall, toks = drive(eng, [r for _, _, r in reqs])
+        unexpected = obs_device.SENTINEL.unexpected - unexpected_before
+        for i, j, r in reqs:
+            assert r.output_tokens == dedicated_out[i][j], (
+                f"PARITY VIOLATION tenant {i} req {j}: "
+                f"{r.output_tokens} != {dedicated_out[i][j]}")
+        stats = eng.adapter_stats()
+        eng.release_steady()
+        return wall, toks, stats, unexpected, pool_bytes
+
+    # Phase A: every tenant resident (pool = N) — density + throughput.
+    res_wall, res_toks, res_stats, res_unexpected, pool_bytes = \
+        pooled_run(n_tenants)
+    # Phase B: pool = N/2 — the steady adapter-SWAPPING loop (loads +
+    # evictions on the decode path; the sentinel must stay silent).
+    swap_wall, swap_toks, swap_stats, swap_unexpected, _ = \
+        pooled_run(pool_size)
+    assert swap_stats["evictions"] > 0, "swap phase never churned lanes"
+    unexpected = res_unexpected + swap_unexpected
+
+    bytes_dedicated = n_tenants * (weight_bytes + kv_bytes)
+    bytes_pooled = weight_bytes + kv_bytes + pool_bytes
+    density = bytes_dedicated / bytes_pooled
+    print(json.dumps({
+        "metric": f"{model} LoRA tenant density: {n_tenants} adapters on "
+                  f"one pooled engine (rank {rank}) vs "
+                  f"{n_tenants} dedicated merged engines",
+        "value": round(density, 2),
+        "unit": "x",
+        # Acceptance >= 2x tenants-per-HBM-byte at equal service, with
+        # inline token parity and a silent compile sentinel across BOTH
+        # pooled phases; any unexpected compile zeroes the gate.
+        "vs_baseline": (0.0 if unexpected
+                        else round(density / 2.0, 4)),
+        "tenants": n_tenants,
+        "adapter_pool_resident": n_tenants,
+        "adapter_pool_swap": pool_size,
+        "lora_rank": rank,
+        "weight_bytes": weight_bytes,
+        "kv_bytes": kv_bytes,
+        "adapter_pool_bytes": pool_bytes,
+        "bytes_dedicated_fleet": bytes_dedicated,
+        "bytes_pooled_engine": bytes_pooled,
+        "pooled_decode_tokens_per_sec": round(res_toks / res_wall, 1),
+        "dedicated_decode_tokens_per_sec": round(
+            dedicated_toks / dedicated_wall, 1),
+        "swap_loop_decode_tokens_per_sec": round(
+            swap_toks / swap_wall, 1),
+        "resident_phase": {k: res_stats[k]
+                           for k in ("loads", "evictions", "hits")},
+        "swap_phase": {k: swap_stats[k]
+                       for k in ("loads", "evictions", "hits")},
+        "greedy_parity": "ok",
+        "unexpected_compiles_steady_loops": unexpected,
         "platform": jax.default_backend(),
         "device": str(device),
     }))
@@ -586,8 +786,11 @@ if __name__ == "__main__":
     paged_axis = os.environ.get("RBT_BENCH_PAGED") == "1"
     router_axis = os.environ.get("RBT_BENCH_ROUTER") == "1"
     spec_axis = os.environ.get("RBT_BENCH_SPEC") == "1"
+    lora_axis = os.environ.get("RBT_BENCH_LORA") == "1"
     if "--inner" in sys.argv:
-        if spec_axis:
+        if lora_axis:
+            lora_inner()
+        elif spec_axis:
             spec_inner()
         elif router_axis:
             router_inner()
@@ -599,7 +802,8 @@ if __name__ == "__main__":
         import benchkit
         benchkit.run_outer(
             os.path.abspath(__file__),
-            *(("speculative decode vs spec-off", "x") if spec_axis
+            *(("LoRA tenant density vs dedicated", "x") if lora_axis
+              else ("speculative decode vs spec-off", "x") if spec_axis
               else ("prefix-aware vs random routing", "x") if router_axis
               else ("paged KV concurrency vs dense", "x") if paged_axis
               else ("serve TTFT p50", "ms")))

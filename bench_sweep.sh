@@ -154,6 +154,14 @@ run gateway-smoke python tools/gateway_smoke.py 3
 #      compile), with token-for-token greedy parity asserted inline.
 run serve-spec env RBT_BENCH_SPEC=1 python bench_serve.py
 
+# 4a5. Multi-tenant LoRA density (docs/multi-tenant-lora.md): 4 adapters
+#      on ONE pooled engine vs 4 dedicated merged-weights engines at the
+#      same service — value is tenants-per-HBM-byte uplift (acceptance
+#      >= 2x, vs_baseline = uplift/2, forced to 0 on any unexpected
+#      compile in the adapter-swapping steady loop), greedy token parity
+#      asserted inline against every dedicated engine.
+run serve-lora env RBT_BENCH_LORA=1 python bench_serve.py
+
 # 4b. Observability instrumentation overhead (docs/observability.md):
 #     the per-step cost of the obs subsystem (spans + histogram observes +
 #     goodput update) as a percent of the real step time, PLUS the fleet-

@@ -67,6 +67,11 @@ class AuditSettings:
     # audit traces the verify factories at this K — the max reachable
     # shape, matching the backend default (utils/hw.backend_tuning).
     draft_tokens: int = 4
+    # Multi-tenant LoRA pool (serve/lora_pool.py): the adapter-aware
+    # program variants are audited at this pool size and rank bucket —
+    # the max shapes a pooled engine ships (docs/multi-tenant-lora.md).
+    adapter_pool: int = 2
+    lora_rank: int = 8
     batch: int = 2
     seq: int = 64
     f32_upcast_bytes: int = 1 << 20   # 1 MiB
@@ -343,6 +348,45 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         _sds((slots,), jnp.float32), _sds((slots,), jnp.int32),
         _sds((slots,), jnp.float32), _sds((slots,), jnp.bool_)]
 
+    # Multi-tenant LoRA adapter variants (docs/multi-tenant-lora.md): a
+    # pooled engine jits THESE shapes instead of the plain set — same
+    # factories, adapter-pool + lane-index operands live. Audited at
+    # settings.adapter_pool/lora_rank (the max reachable pool shapes);
+    # signature cardinality matches the plain programs 1:1 (the pool
+    # replaces, never multiplies, the census).
+    from runbooks_tpu.ops.lora import init_adapter_pool
+
+    apool = jax.eval_shape(lambda: init_adapter_pool(
+        cfg, settings.adapter_pool, settings.lora_rank,
+        cfg.lora_targets))
+
+    def aslots_sds(rows):
+        return _sds((rows,), jnp.int32)
+
+    def adapter_prefill(params_, pool_, apool_, aslots_, *rest):
+        return prefill(params_, pool_, *rest, apool=apool_,
+                       aslots=aslots_)
+
+    def adapter_decode(params_, pool_, apool_, aslots_, *rest):
+        return decode(params_, pool_, *rest, apool=apool_,
+                      aslots=aslots_)
+
+    def adapter_verify(params_, pool_, apool_, aslots_, *rest):
+        return verify(params_, pool_, *rest, apool=apool_,
+                      aslots=aslots_)
+
+    def paged_adapter_prefill(params_, pool_, apool_, aslots_, *rest):
+        return paged_prefill(params_, pool_, *rest, apool=apool_,
+                             aslots=aslots_)
+
+    def paged_adapter_decode(params_, pool_, apool_, aslots_, *rest):
+        return paged_decode(params_, pool_, *rest, apool=apool_,
+                            aslots=aslots_)
+
+    def paged_adapter_verify(params_, pool_, apool_, aslots_, *rest):
+        return paged_verify(params_, pool_, *rest, apool=apool_,
+                            aslots=aslots_)
+
     return [
         {"component": "serve", "name": "prefill", "fn": prefill,
          "args": prefill_args(rows_set[-1], buckets[-1]),
@@ -367,6 +411,36 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
          "args": verify_args, "signatures": len(views)},
         {"component": "serve", "name": "paged_verify",
          "fn": paged_verify, "args": paged_verify_args,
+         "signatures": len(vp_buckets)},
+        {"component": "serve", "name": "adapter_prefill",
+         "fn": adapter_prefill,
+         "args": ([params, pool, apool, aslots_sds(rows_set[-1])]
+                  + prefill_args(rows_set[-1], buckets[-1])[2:]),
+         "signatures": len(buckets) * len(rows_set)},
+        {"component": "serve", "name": "adapter_decode",
+         "fn": adapter_decode,
+         "args": ([params, pool, apool, aslots_sds(slots)]
+                  + decode_args[2:]),
+         "signatures": len(views)},
+        {"component": "serve", "name": "adapter_verify",
+         "fn": adapter_verify,
+         "args": ([params, pool, apool, aslots_sds(slots)]
+                  + verify_args[2:]),
+         "signatures": len(views)},
+        {"component": "serve", "name": "paged_adapter_prefill",
+         "fn": paged_adapter_prefill,
+         "args": ([params, paged_pool, apool, aslots_sds(slots)]
+                  + paged_prefill_args[2:]),
+         "signatures": len(pshapes) * len(rows_set)},
+        {"component": "serve", "name": "paged_adapter_decode",
+         "fn": paged_adapter_decode,
+         "args": ([params, paged_pool, apool, aslots_sds(slots)]
+                  + paged_decode_args[2:]),
+         "signatures": len(vp_buckets)},
+        {"component": "serve", "name": "paged_adapter_verify",
+         "fn": paged_adapter_verify,
+         "args": ([params, paged_pool, apool, aslots_sds(slots)]
+                  + paged_verify_args[2:]),
          "signatures": len(vp_buckets)},
     ]
 
@@ -469,6 +543,8 @@ def audit_programs(
                      "max_slots": settings.max_slots,
                      "decode_chunk": settings.decode_chunk,
                      "draft_tokens": settings.draft_tokens,
+                     "adapter_pool": settings.adapter_pool,
+                     "lora_rank": settings.lora_rank,
                      "batch": settings.batch, "seq": settings.seq},
         "programs": programs,
     }

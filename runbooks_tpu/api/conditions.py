@@ -48,6 +48,14 @@ REASON_SLICE_RUNNING = "PodSliceRunning"
 # spec.params validation failed (e.g. quantize outside none|int8|int4) —
 # terminal until the spec changes, like the reference's webhook rejections.
 REASON_INVALID_PARAMS = "InvalidParams"
+# Shared-engine tenant Servers (spec.engineRef, docs/multi-tenant-lora.md):
+# a tenant maps onto another Server's pooled engine instead of its own
+# Deployment. Not-found/not-ready mirror the Model gating reasons; NoPool
+# flags a host without an adapter_pool (the tenant's per-request adapter
+# would 400 on every call).
+REASON_ENGINE_NOT_FOUND = "SharedEngineNotFound"
+REASON_ENGINE_NOT_READY = "SharedEngineNotReady"
+REASON_ENGINE_NO_POOL = "SharedEngineNoAdapterPool"
 # SLOViolated reasons: the violated objective by name (the condition
 # message carries measured-vs-target for every violated objective), or
 # the healthy/empty states.

@@ -994,6 +994,17 @@ def _top_detail(families, kind: str, sel: dict) -> str:
             accepted = _metric_value(families,
                                      "serve_spec_accepted_total", sel) or 0
             parts.append(f"acc={accepted / drafted * 100:.0f}%")
+        # Multi-tenant LoRA pool (docs/multi-tenant-lora.md): resident
+        # adapters + cumulative loads. The serve_adapter_* families exist
+        # only on pooled engines, so the cell appears exactly there.
+        resident = _metric_value(families, "serve_adapters_resident", sel)
+        if resident is not None:
+            loads = _metric_value(families, "serve_adapter_loads_total",
+                                  sel)
+            cell = f"adapters={resident:.0f}"
+            if loads:
+                cell += f"/{loads:.0f}ld"
+            parts.append(cell)
         # Last-incident age (obs/incident.py): the series exists only
         # once the replica captured a bundle — absence means "never".
         inc_age = _metric_value(families, "serve_incident_age_seconds",

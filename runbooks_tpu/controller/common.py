@@ -117,6 +117,15 @@ _NGRAM_MIN_KEYS = ("ngram_min", "ngramMin", "ngrammin")
 DEFAULT_NGRAM_MAX = 3
 DEFAULT_NGRAM_MIN = 1
 
+# Multi-tenant batched LoRA serving knobs (serve/lora_pool.py,
+# docs/multi-tenant-lora.md): adapter_pool sizes the HBM adapter pool
+# (0 = off — `adapter` then folds at load), lora_rank the static rank
+# bucket every pool lane pads to. Same three-spelling convention as the
+# other serving knobs.
+_ADAPTER_POOL_KEYS = ("adapter_pool", "adapterPool", "adapterpool")
+_LORA_RANK_KEYS = ("lora_rank", "loraRank", "lorarank")
+_ADAPTER_DIR_KEYS = ("adapter_dir", "adapterDir", "adapterdir")
+
 INT_PARAMS = {
     "loss_chunk": 0,
     "prefetch_depth": 0,
@@ -142,6 +151,10 @@ INT_PARAMS = {
     # Consecutive non-finite steps the trainer tolerates before aborting.
     **{k: 1 for k in _MAX_BAD_STEPS_KEYS},
     **{k: 0 for k in _RESTART_KEYS},
+    # Multi-tenant LoRA serving (docs/multi-tenant-lora.md): pool size 0
+    # is valid (off); the rank bucket must hold at least one column.
+    **{k: 0 for k in _ADAPTER_POOL_KEYS},
+    **{k: 1 for k in _LORA_RANK_KEYS},
 }
 
 # Float-valued params the workloads float()-coerce at startup: key ->
@@ -310,6 +323,32 @@ def validate_params(params: dict) -> Optional[str]:
     if int(ngram_min) > int(ngram_max):
         return (f"spec.params.ngram_min: {ngram_min} must be <= "
                 f"ngram_max {ngram_max}")
+    # Multi-tenant LoRA cross-field checks (docs/multi-tenant-lora.md):
+    # `adapter` must be a non-empty string (it names an artifact path);
+    # a pool-tuning knob without a pool serves nothing (spec typo); and
+    # `adapter` + `adapter_pool` on ONE Server is ambiguous — the fold
+    # path and the pool are mutually exclusive serving modes (tenants
+    # name the pool host via spec.engineRef instead).
+    adapter = params.get("adapter")
+    if adapter is not None and (not isinstance(adapter, str)
+                                or not adapter.strip()):
+        return f"spec.params.adapter: {adapter!r} must be a non-empty path"
+    pool_val = next((params[k] for k in _ADAPTER_POOL_KEYS
+                     if params.get(k) is not None), 0)
+    if int(pool_val or 0) == 0:
+        knob_set = next(
+            (k for k in _LORA_RANK_KEYS + _ADAPTER_DIR_KEYS
+             if params.get(k) is not None), None)
+        if knob_set is not None:
+            return (f"spec.params.{knob_set}: only applies to a pooled "
+                    "engine; set adapter_pool >= 1 "
+                    "(docs/multi-tenant-lora.md)")
+    elif adapter is not None:
+        return ("spec.params.adapter: cannot combine with adapter_pool "
+                "on one Server — the load-time fold serves ONE tenant, "
+                "the pool serves per-request adapters; point tenant "
+                "Servers at this pool via spec.engineRef instead "
+                "(docs/multi-tenant-lora.md)")
     accum = next((params[k] for k in _ACCUM_KEYS
                   if params.get(k) is not None), None)
     if accum is not None:

@@ -52,6 +52,11 @@ DEPENDENT_INDEXES: Dict[str, List[tuple]] = {
     "Model": [("Server", "model"), ("Notebook", "model"),
               ("Model", "baseModel"), ("Model", "model")],
     "Dataset": [("Model", "dataset"), ("Notebook", "dataset")],
+    # Shared-engine tenants (docs/multi-tenant-lora.md): a host Server's
+    # readiness flip or deletion must re-reconcile every tenant Server
+    # whose spec.engineRef names it — a tenant mirrors the host's state
+    # and would otherwise stay stale until the full resync.
+    "Server": [("Server", "engineRef")],
 }
 
 
@@ -345,6 +350,12 @@ class Manager:
         kind per event (its ref fields scanned together), not one per
         index entry — events are frequent and LISTs against a real
         apiserver are not free."""
+        def ref_name(dep, field):
+            # Refs come in two spellings: {name: x} objects (model/
+            # dataset/baseModel) and plain strings (engineRef).
+            ref = ko.deep_get(dep, "spec", field, default=None)
+            return ref.get("name") if isinstance(ref, dict) else ref
+
         by_kind: Dict[str, List[str]] = {}
         for dep_kind, ref_field in DEPENDENT_INDEXES.get(kind, ()):
             if dep_kind in self.reconcilers:
@@ -352,6 +363,8 @@ class Manager:
         for dep_kind, ref_fields in by_kind.items():
             for dep in self.ctx.client.list(API_VERSION, dep_kind,
                                             namespace=ko.namespace(obj)):
-                if any((ko.deep_get(dep, "spec", f, default={}) or {})
-                       .get("name") == ko.name(obj) for f in ref_fields):
+                if ko.name(dep) == ko.name(obj) and dep_kind == kind:
+                    continue  # an object is never its own dependent
+                if any(ref_name(dep, f) == ko.name(obj)
+                       for f in ref_fields):
                     self._reconcile_one(dep_kind, dep, pending)

@@ -149,8 +149,6 @@ class ServerReconciler:
 
     def reconcile(self, ctx: Ctx, raw: dict) -> Result:
         server = Server(raw)
-        if not server.image:
-            return Result(requeue_after=1.0)
         err = validate_params(server.params) \
             or validate_slo(server.spec.get("slo")) \
             or validate_gateway(server.spec.get("gateway")) \
@@ -163,6 +161,15 @@ class ServerReconciler:
                                  cond.REASON_INVALID_PARAMS, err)
             server.commit_status(ctx.client)
             return Result()
+        if server.spec.get("engineRef"):
+            # Multi-tenant LoRA tenant (docs/multi-tenant-lora.md): this
+            # Server maps onto another Server's pooled engine instead of
+            # deploying its own — N fine-tunes cost ONE engine's HBM.
+            # Runs before the image gate: a tenant deploys no container,
+            # so it needs no image.
+            return self._reconcile_shared_engine(ctx, server)
+        if not server.image:
+            return Result(requeue_after=1.0)
         reconcile_params_configmap(ctx.client, server)
 
         if not server.model_ref:
@@ -274,6 +281,74 @@ class ServerReconciler:
             requeue = (SLO_REQUEUE_S if requeue is None
                        else min(requeue, SLO_REQUEUE_S))
         return Result(requeue_after=requeue)
+
+    # ------------------------------------------------------------------
+
+    def _reconcile_shared_engine(self, ctx: Ctx, server: Server) -> Result:
+        """Tenant Server with ``spec.engineRef``: instead of a Deployment
+        per fine-tune (N tenants = N x base weights in HBM), the tenant
+        maps onto ANOTHER Server's pooled engine (docs/multi-tenant-
+        lora.md). What the tenant gets: spec validation (adapter
+        required, host must exist / be serving / run an adapter pool), a
+        params ConfigMap (the contract record of its adapter), and a
+        Service ALIASING the host's replica pods — clients of the tenant
+        hit the shared engine, passing the adapter per request. No
+        Deployment is ever created for the tenant."""
+        ref = str(server.spec.get("engineRef"))
+        if not (server.params.get("adapter") or "").strip():
+            server.set_condition(
+                cond.SERVING, False, cond.REASON_INVALID_PARAMS,
+                "spec.engineRef requires spec.params.adapter (the "
+                "tenant's fine-tune to serve)")
+            server.commit_status(ctx.client)
+            return Result()
+        reconcile_params_configmap(ctx.client, server)
+        from runbooks_tpu.api.types import API_VERSION
+
+        host = ctx.client.get(API_VERSION, "Server",
+                              server.namespace, ref)
+        if host is None:
+            server.set_condition(
+                cond.SERVING, False, cond.REASON_ENGINE_NOT_FOUND,
+                f"shared engine Server {ref!r} not found")
+            server.commit_status(ctx.client)
+            return Result(requeue_after=2.0)
+        from runbooks_tpu.controller.common import _ADAPTER_POOL_KEYS
+
+        host_params = ko.deep_get(host, "spec", "params", default={}) or {}
+        pool = next((host_params[k] for k in _ADAPTER_POOL_KEYS
+                     if host_params.get(k) is not None), 0)
+        try:
+            pool = int(pool)
+        except (TypeError, ValueError):
+            pool = 0
+        if pool < 1:
+            server.set_condition(
+                cond.SERVING, False, cond.REASON_ENGINE_NO_POOL,
+                f"shared engine Server {ref!r} has no adapter pool "
+                "(spec.params.adapter_pool >= 1 required)")
+            server.commit_status(ctx.client)
+            return Result(requeue_after=2.0)
+        # Tenant ingress: a Service selecting the HOST's replica pods.
+        svc = self._service(server)
+        svc["spec"]["selector"] = {"server": ref, "role": "run"}
+        ko.set_owner(svc, server.obj)
+        ctx.client.apply(svc, FIELD_MANAGER)
+        host_ready = bool(ko.deep_get(host, "status", "ready",
+                                      default=False))
+        changed = server.set_condition(
+            cond.SERVING, host_ready,
+            cond.REASON_DEPLOYMENT_READY if host_ready
+            else cond.REASON_ENGINE_NOT_READY,
+            (f"served by shared engine servers/{ref} "
+             f"(adapter {server.params.get('adapter')!r})") if host_ready
+            else f"shared engine servers/{ref} is not serving yet")
+        if server.ready != host_ready:
+            server.set_ready(host_ready)
+            changed = True
+        if changed:
+            server.commit_status(ctx.client)
+        return Result(requeue_after=None if host_ready else 2.0)
 
     # ------------------------------------------------------------------
 

@@ -208,6 +208,25 @@ class ModelConfig:
     ngram_max: int = 3
     ngram_min: int = 1
 
+    # Multi-tenant batched LoRA serving (serve/lora_pool.py,
+    # docs/multi-tenant-lora.md): adapter_pool > 0 gives the serve engine
+    # an HBM-resident pool of that many LoRA adapters (plus one all-zero
+    # trash lane for base-only rows) and compiles adapter-aware
+    # prefill/decode/verify programs — per-request `adapter` then selects
+    # a lane per slot inside ONE batched dispatch. 0 (default) = off: the
+    # engine compiles the plain program set, and a Server-level
+    # `adapter: <path>` folds the weights at load time instead
+    # (train/lora.py apply_lora — the single-tenant baseline).
+    adapter_pool: int = 0
+    # Static rank bucket every pool lane is padded to. A per-tenant rank
+    # would be a per-tenant compiled program; adapters trained at r <=
+    # lora_rank zero-pad (exact), larger ranks are rejected at load.
+    lora_rank: int = 8
+    # Targets eligible for pooled injection (dotted paths into
+    # params["layers"], same vocabulary as train/lora.py). Attention-only
+    # by default, mirroring the training default.
+    lora_targets: tuple = ("attn.wq", "attn.wk", "attn.wv", "attn.wo")
+
     # Training-time behavior. "nothing_saveable" = full remat (memory-safe
     # default); "dots_saveable" / "dots_with_no_batch_dims_saveable" save
     # matmul outputs; "save_attn_out" saves only the named per-layer

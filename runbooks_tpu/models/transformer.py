@@ -478,6 +478,26 @@ def _dispatch_attention(cfg: ModelConfig, q, k, v, positions, segment_ids,
                                  logit_softcap=cfg.logit_softcap)
 
 
+def _adapter_delta(adapter, name: str, x_in: jax.Array, y: jax.Array,
+                   ad) -> jax.Array:
+    """Add the grouped per-row LoRA delta for one target to the base
+    projection's output (docs/multi-tenant-lora.md). ``adapter`` is
+    None (off) or (pool_layer, lane_idx): pool_layer a nested
+    {"attn"/"mlp": {target: {"a", "b"}}} slice for THIS layer, lane_idx
+    the per-row int32 lane indices (already trash-mapped). Targets
+    absent from the pool pass through untouched, so a pool configured
+    for attention-only injection costs the MLP nothing."""
+    if adapter is None:
+        return y
+    sub, idx = adapter
+    ab = sub.get(name)
+    if ab is None:
+        return y
+    from runbooks_tpu.ops.lora import grouped_lora_delta
+
+    return y + grouped_lora_delta(x_in, ab, idx, ad)
+
+
 def _attention_block(
     cfg: ModelConfig,
     p: Params,
@@ -487,6 +507,7 @@ def _attention_block(
     mask: Optional[jax.Array],
     bias: Optional[jax.Array],
     layer_cache: Optional[Tuple[jax.Array, jax.Array, jax.Array]],
+    adapter=None,
 ):
     b, s, _ = x.shape
     ad = cfg.activation_dtype
@@ -495,15 +516,18 @@ def _attention_block(
     ring_row = "rs" if ring_on else None
     bidir = cfg.collective_matmul_bidirectional
 
-    def proj(w, bname):
+    def proj(w, bname, aname):
         y = _matmul(x, w, ad, ring=ring_col, ring_bidir=bidir)
+        y = _adapter_delta(adapter, aname, x, y, ad)
         if bname in p:
             y = y + p[bname].astype(ad)
         return y
 
-    q = proj(p["wq"], "bq").reshape(b, s, cfg.num_heads, cfg.head_dim)
-    k = proj(p["wk"], "bk").reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
-    v = proj(p["wv"], "bv").reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = proj(p["wq"], "bq", "wq").reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = proj(p["wk"], "bk", "wk").reshape(b, s, cfg.num_kv_heads,
+                                          cfg.head_dim)
+    v = proj(p["wv"], "bv", "wv").reshape(b, s, cfg.num_kv_heads,
+                                          cfg.head_dim)
     q = with_logical_constraint(q, ("batch", "seq", "act_heads", None))
     k = with_logical_constraint(k, ("batch", "seq", "act_heads", None))
     v = with_logical_constraint(v, ("batch", "seq", "act_heads", None))
@@ -589,13 +613,16 @@ def _attention_block(
         out = _dispatch_attention(cfg, q, k, v, positions, segment_ids,
                                   mask, bias)
     out = out.reshape(b, s, cfg.q_dim)
+    attn_ctx = out
     out = _matmul(out, p["wo"], ad, ring=ring_row, ring_bidir=bidir)
+    out = _adapter_delta(adapter, "wo", attn_ctx, out, ad)
     if "bo" in p:
         out = out + p["bo"].astype(ad)
     return out, new_layer_cache
 
 
-def _mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
+def _mlp_block(cfg: ModelConfig, p: Params, x: jax.Array,
+               adapter=None) -> jax.Array:
     ad = cfg.activation_dtype
     ring_on = resolve_collective_matmul(cfg)
     bidir = cfg.collective_matmul_bidirectional
@@ -606,43 +633,61 @@ def _mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
     ring_col = "ag" if ring_on else None
     ring_row = "rs" if ring_on else None
     if cfg.gated_mlp:
-        gate = mm(x, p["wi_gate"], ring_col)
-        up = mm(x, p["wi_up"], ring_col)
+        gate = _adapter_delta(adapter, "wi_gate", x,
+                              mm(x, p["wi_gate"], ring_col), ad)
+        up = _adapter_delta(adapter, "wi_up", x,
+                            mm(x, p["wi_up"], ring_col), ad)
         if "bi_gate" in p:
             gate = gate + p["bi_gate"].astype(ad)
             up = up + p["bi_up"].astype(ad)
         hidden = _activation(cfg, gate) * up
     else:
-        hidden = mm(x, p["wi"], ring_col)
+        hidden = _adapter_delta(adapter, "wi", x,
+                                mm(x, p["wi"], ring_col), ad)
         if "bi" in p:
             hidden = hidden + p["bi"].astype(ad)
         hidden = _activation(cfg, hidden)
     hidden = with_logical_constraint(hidden, ("batch", "seq", "act_mlp"))
-    out = mm(hidden, p["wo"], ring_row)
+    out = _adapter_delta(adapter, "wo", hidden,
+                         mm(hidden, p["wo"], ring_row), ad)
     if "bo" in p:
         out = out + p["bo"].astype(ad)
     return out
 
 
-def _ffn_block(cfg: ModelConfig, layer: Params, x: jax.Array):
+def _ffn_block(cfg: ModelConfig, layer: Params, x: jax.Array,
+               adapter=None):
     """Dense MLP or MoE, returning (out, aux-loss scalar)."""
     if cfg.moe_num_experts:
         from runbooks_tpu.models.moe import moe_block
 
         return moe_block(cfg, layer["moe"], x)
-    return _mlp_block(cfg, layer["mlp"], x), jnp.zeros((), jnp.float32)
+    return (_mlp_block(cfg, layer["mlp"], x, adapter=adapter),
+            jnp.zeros((), jnp.float32))
+
+
+def _adapter_group(adapter, group: str):
+    """(group_pool, idx) for one block sub-module, or None when the pool
+    has no targets there."""
+    if adapter is None:
+        return None
+    pool_layer, idx = adapter
+    sub = pool_layer.get(group)
+    return None if sub is None else (sub, idx)
 
 
 def _block(cfg: ModelConfig, layer: Params, x, positions, segment_ids, mask,
-           bias, layer_cache):
-    """One transformer block. x: [b, s, h]. Returns (x, cache, aux)."""
+           bias, layer_cache, adapter=None):
+    """One transformer block. x: [b, s, h]. Returns (x, cache, aux).
+    ``adapter``: None or (per-layer adapter-pool slice, lane indices) —
+    the grouped LoRA injection (docs/multi-tenant-lora.md)."""
     act_rules = _act_embed_rules(resolve_collective_matmul(cfg))
     x = with_logical_constraint(x, ("batch", "seq", "act_embed"),
                                 rules=act_rules)
     h1 = _norm(cfg, layer["ln1"], x)
     attn_out, new_cache = _attention_block(
         cfg, layer["attn"], h1, positions, segment_ids, mask, bias,
-        layer_cache)
+        layer_cache, adapter=_adapter_group(adapter, "attn"))
     # Named checkpoint for selective remat: remat_policy="save_attn_out"
     # saves this [b, s, h] tensor (plus the flash kernel's hoisted
     # "attn_context"/"attn_lse" residuals — see ops/flash_attention.py) so
@@ -650,14 +695,15 @@ def _block(cfg: ModelConfig, layer: Params, x, positions, segment_ids, mask,
     # activations stay O(layers * b * s * h) instead of the dots_saveable
     # blow-up.
     attn_out = checkpoint_name(attn_out, "attn_out")
+    mlp_adapter = _adapter_group(adapter, "mlp")
     if cfg.parallel_block:
         h2 = h1 if cfg.shared_layer_norm else _norm(cfg, layer["ln2"], x)
-        mlp_out, aux = _ffn_block(cfg, layer, h2)
+        mlp_out, aux = _ffn_block(cfg, layer, h2, adapter=mlp_adapter)
         x = x + attn_out + mlp_out
     else:
         x = x + attn_out
         h2 = _norm(cfg, layer["ln2"], x)
-        ffn_out, aux = _ffn_block(cfg, layer, h2)
+        ffn_out, aux = _ffn_block(cfg, layer, h2, adapter=mlp_adapter)
         x = x + ffn_out
     x = with_logical_constraint(x, ("batch", "seq", "act_embed"),
                                 rules=act_rules)
@@ -680,6 +726,7 @@ def forward(
     remat: bool = False,
     with_aux: bool = False,
     return_activations: bool = False,
+    adapters=None,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Returns (logits [b, s, vocab] float32, updated cache or None) — or,
     with_aux=True, (logits, cache, aux) where aux is the summed per-layer
@@ -701,6 +748,16 @@ def forward(
     is < cache_view; the serving engine picks the smallest bucketed view
     covering current occupancy so decode doesn't stream the whole
     max-length cache through HBM each step.
+
+    adapters: None or (pool, lane_idx) — the multi-tenant batched LoRA
+    injection (ops/lora.py, docs/multi-tenant-lora.md). ``pool`` is the
+    stacked adapter pytree ({"attn"/"mlp": {target: {"a": [L, lanes,
+    d_in, r], "b": [L, lanes, r, d_out]}}}); ``lane_idx`` [b] int32
+    selects each row's adapter lane (-1 = base-only, mapped to the
+    all-zero trash lane). The pool scans with the layers, and every
+    targeted projection adds its row's ``(x @ A) @ B`` delta — one
+    program for any tenant mix. Not supported on the pipeline (stage >
+    1) path.
     """
     b, s = tokens.shape
     ad = cfg.activation_dtype
@@ -778,28 +835,43 @@ def forward(
             _block, policy=_remat_policy(cfg.remat_policy),
             static_argnums=(0,))
 
+    apool = aidx = None
+    if adapters is not None:
+        from runbooks_tpu.ops.lora import map_lane_indices, pool_lanes
+
+        apool, aidx = adapters
+        aidx = map_lane_indices(jnp.asarray(aidx), pool_lanes(apool))
+
     def scan_body(carry, scanned):
         x, aux_sum = carry
+        if apool is not None:
+            *scanned, pool_layer = scanned
+            adapter = (pool_layer, aidx)
+        else:
+            adapter = None
         if cache is not None:
             layer, ck, cv, ck_s, cv_s = scanned
             layer_cache = (ck, cv, ck_s, cv_s,
                            None if scatter_mode else cache.index,
                            cache_view)
         else:
-            layer = scanned
+            (layer,) = scanned if apool is not None else (scanned,)
             layer_cache = None
         x, new_cache, aux = block(cfg, layer, x, positions, segment_ids,
-                                  mask, bias, layer_cache)
+                                  mask, bias, layer_cache, adapter)
         return (x, aux_sum + aux), new_cache
 
     aux_total = jnp.zeros((), jnp.float32)
     if cache is not None:
         # k_scale/v_scale are None (empty pytrees) for an unquantized
-        # cache; scan threads them through untouched either way.
+        # cache; scan threads them through untouched either way. The
+        # adapter pool (leading L axis) rides the same scan when given.
+        xs = (params["layers"], cache.k, cache.v,
+              cache.k_scale, cache.v_scale)
+        if apool is not None:
+            xs = xs + (apool,)
         (x, aux_total), (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
-            scan_body, (x, aux_total),
-            (params["layers"], cache.k, cache.v,
-             cache.k_scale, cache.v_scale))
+            scan_body, (x, aux_total), xs)
         new_index = cache.index if scatter_mode else cache.index + s
         new_cache = KVCache(k=new_k, v=new_v, index=new_index,
                             k_scale=new_ks, v_scale=new_vs)
@@ -810,6 +882,11 @@ def forward(
         n_stages = int(mesh.shape.get("stage", 1)) if mesh is not None \
             else 1
         if n_stages > 1:
+            if apool is not None:
+                raise NotImplementedError(
+                    "adapter pools are not supported on the pipeline "
+                    "(stage > 1) path; serve adapters with tensor/data "
+                    "parallelism (docs/multi-tenant-lora.md)")
             # Pipeline-parallel path: same block, stacked layers sharded
             # over the stage axis, activations ppermuted between stages
             # (parallel/pipeline.py).
@@ -826,8 +903,10 @@ def forward(
                 mesh=mesh, n_stages=n_stages,
                 n_microbatches=cfg.pipeline_microbatches or None)
         else:
+            xs = (params["layers"] if apool is None
+                  else (params["layers"], apool))
             (x, aux_total), _ = jax.lax.scan(
-                scan_body, (x, aux_total), params["layers"])
+                scan_body, (x, aux_total), xs)
         new_cache = None
 
     x = _norm(cfg, params["final_norm"], x)

@@ -129,6 +129,13 @@ CATALOG: Dict[str, str] = {
     "serve_kv_pages_used": "gauge",
     "serve_kv_pages_shared": "gauge",
     "serve_prefix_pages_reused_total": "counter",
+    # Multi-tenant LoRA adapter pool (serve/lora_pool.py,
+    # docs/multi-tenant-lora.md): exported only by pooled engines
+    "serve_adapter_loads_total": "counter",
+    "serve_adapter_evictions_total": "counter",
+    "serve_adapter_hits_total": "counter",
+    "serve_adapter_requests_total": "counter",
+    "serve_adapters_resident": "gauge",
     # Serving gateway (serve/gateway.py, docs/serving-dataplane.md):
     # the multi-replica routing data plane
     "gateway_requests_total": "counter",

@@ -1,0 +1,195 @@
+"""Grouped per-slot LoRA adapter matmul for multi-tenant batched serving.
+
+Training produces per-tenant LoRA adapters (train/lora.py: A [L, in, r],
+B [L, r, out] per target matrix); serving one adapter per engine wastes
+N x base-weight HBM for N tenants. The batched-serving design
+(docs/multi-tenant-lora.md) keeps ONE set of base weights plus a bounded
+pool of adapters resident in HBM as a stacked pytree, and this module
+supplies the math that lets heterogeneous-adapter rows share a single
+forward dispatch:
+
+- ``grouped_lora_delta``: each batch row gathers ITS adapter's A/B from
+  the stacked ``[lanes, ...]`` pool by an int32 lane index and adds
+  ``(x @ A) @ B`` to the base projection's output. Lane indices are a
+  plain operand, so a batch mixing four tenants (or tenants and base-only
+  rows) is still ONE compiled program — the per-slot analogue of the
+  engine's per-slot sampling-params batching.
+- **Trash lane**: pool lane ``lanes - 1`` is all-zero and never written
+  with a real adapter; rows with lane index -1 (base-only traffic) are
+  mapped there, so "no adapter" costs one gathered zero matmul instead of
+  a second program.
+- **Quantized-base compose**: the delta ADDS to the projection output, so
+  it composes with weight-only int8/int4 base params (QuantizedArray —
+  ops/quantization.py) unchanged: the fused dequant-matmul produces the
+  base projection and the bf16 adapter delta rides on top. Folding into a
+  packed base is impossible (int4 has no headroom); composing is exact.
+- **Rank bucket**: every pool lane has the same static rank R (the
+  compiled shapes must not depend on the tenant). Adapters trained at
+  r < R zero-pad A's and B's rank axis; padding columns contribute
+  exactly 0. Each adapter's own alpha/rank scale is folded into its B at
+  load time, so the jitted delta needs no per-row scale operand. Both
+  happen in NumPy on the serving load path
+  (serve/lora_pool.load_adapter_tree — eager jax ops there would
+  compile under traffic).
+
+The serving pool manager (host LRU, refcounts, artifact loading) lives in
+serve/lora_pool.py; this module is pure math shared by the transformer's
+injection points and the pool's device-side write program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+# Matrices eligible for serving-time adapter injection, by their dotted
+# path inside params["layers"] — mirrors train/lora.py's target set (the
+# artifacts it saves are what the pool loads).
+ADAPTER_TARGETS = ("attn.wq", "attn.wk", "attn.wv", "attn.wo",
+                   "mlp.wi_gate", "mlp.wi_up", "mlp.wi", "mlp.wo")
+
+
+def target_dims(cfg, target: str) -> Tuple[int, int]:
+    """(d_in, d_out) of a LoRA target matrix under ``cfg``. Raises for
+    targets the architecture does not have (e.g. ``mlp.wi`` on a gated
+    model) so a misconfigured pool fails at construction, not at the
+    first admission."""
+    h = cfg.hidden_size
+    dims = {
+        "attn.wq": (h, cfg.q_dim), "attn.wk": (h, cfg.kv_dim),
+        "attn.wv": (h, cfg.kv_dim), "attn.wo": (cfg.q_dim, h),
+    }
+    if cfg.moe_num_experts == 0:
+        m = cfg.intermediate_size
+        if cfg.gated_mlp:
+            dims.update({"mlp.wi_gate": (h, m), "mlp.wi_up": (h, m),
+                         "mlp.wo": (m, h)})
+        else:
+            dims.update({"mlp.wi": (h, m), "mlp.wo": (m, h)})
+    if target not in dims:
+        raise ValueError(
+            f"LoRA target {target!r} does not exist on model "
+            f"{cfg.name!r} (moe={bool(cfg.moe_num_experts)}, "
+            f"gated_mlp={cfg.gated_mlp}); available: {sorted(dims)}")
+    return dims[target]
+
+
+def nest_targets(flat: Dict[str, Any]) -> Params:
+    """{"attn.wq": v} -> {"attn": {"wq": v}} — the pool pytree mirrors the
+    params["layers"] nesting so the transformer's blocks can look their
+    own targets up without dotted-path plumbing."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for dotted, v in flat.items():
+        group, name = dotted.split(".", 1)
+        out.setdefault(group, {})[name] = v
+    return out
+
+
+def init_adapter_pool(cfg, pool_size: int, rank: int,
+                      targets: Sequence[str]) -> Params:
+    """All-zero stacked adapter pool: per target
+    {"a": [L, pool_size + 1, d_in, rank], "b": [L, pool_size + 1, rank,
+    d_out]} in the activation dtype. Lane ``pool_size`` is the TRASH
+    lane — never written, so base-only rows gather exact zeros. Leading
+    L axis so the forward's layer scan threads per-layer slices."""
+    if pool_size < 1:
+        raise ValueError(f"adapter pool_size must be >= 1, got {pool_size}")
+    if rank < 1:
+        raise ValueError(f"lora_rank must be >= 1, got {rank}")
+    L, ad = cfg.num_layers, cfg.activation_dtype
+    flat = {}
+    for t in targets:
+        d_in, d_out = target_dims(cfg, t)
+        flat[t] = {
+            "a": jnp.zeros((L, pool_size + 1, d_in, rank), ad),
+            "b": jnp.zeros((L, pool_size + 1, rank, d_out), ad),
+        }
+    return nest_targets(flat)
+
+
+def pool_lanes(pool: Params) -> int:
+    """Lane count (pool_size + 1, trash included) of a pool pytree.
+    Works on full [L, lanes, ...] arrays and per-layer [lanes, ...]
+    slices alike via the shared lane axis position from the 'a' leaf."""
+    leaf = jax.tree.leaves(pool)[0]
+    # Full pool leaves are rank-4 [L, lanes, d, r]; per-layer slices
+    # rank-3 [lanes, d, r].
+    return leaf.shape[1] if leaf.ndim == 4 else leaf.shape[0]
+
+
+def map_lane_indices(idx: jax.Array, lanes: int) -> jax.Array:
+    """Per-row lane indices with -1 (base-only) mapped to the trash lane
+    (lanes - 1) and everything clipped into range."""
+    idx = idx.astype(jnp.int32)
+    return jnp.clip(jnp.where(idx < 0, lanes - 1, idx), 0, lanes - 1)
+
+
+def grouped_lora_delta(x: jax.Array, ab: Params, idx: jax.Array,
+                       compute_dtype) -> jax.Array:
+    """Per-row adapter delta ``(x @ A[idx]) @ B[idx]`` for one target.
+
+    x:   [rows, s, d_in] activations feeding the base projection
+    ab:  {"a": [lanes, d_in, r], "b": [lanes, r, d_out]} (one layer's
+         pool slice; per-adapter scale already folded into b)
+    idx: [rows] int32 lane indices, ALREADY trash-mapped
+         (map_lane_indices)
+
+    Returns [rows, s, d_out] in compute_dtype. f32 accumulation on both
+    dots (preferred_element_type), same discipline as the base _matmul;
+    rank r is small so the gathered [rows, d, r] operands are cheap next
+    to the base projection the delta rides on."""
+    a_sel = jnp.take(ab["a"], idx, axis=0)          # [rows, d_in, r]
+    b_sel = jnp.take(ab["b"], idx, axis=0)          # [rows, r, d_out]
+    t = jnp.einsum("bsd,bdr->bsr", x.astype(compute_dtype),
+                   a_sel.astype(compute_dtype),
+                   preferred_element_type=jnp.float32)
+    d = jnp.einsum("bsr,bro->bso", t.astype(compute_dtype),
+                   b_sel.astype(compute_dtype),
+                   preferred_element_type=jnp.float32)
+    return d.astype(compute_dtype)
+
+
+def make_pool_write_fn():
+    """One jitted write program: splice a single adapter's [L, ...]
+    arrays into pool lane ``lane``. The lane index is a traced operand,
+    so swapping adapters under traffic reuses ONE compiled program — the
+    compile-sentinel discipline the whole engine runs on. Donate the
+    pool at the jit call site (in-place update, no full-pool copy)."""
+
+    def write_fn(pool: Params, adapter: Params, lane) -> Params:
+        def splice(p, a):
+            return jax.lax.dynamic_update_slice_in_dim(
+                p, a[:, None].astype(p.dtype), lane, axis=1)
+
+        return jax.tree.map(splice, pool, adapter)
+
+    return write_fn
+
+
+def adapter_pool_logical_axes(pool: Params) -> Params:
+    """Logical axes for the device pool under a serving mesh: pool-lane
+    and rank axes replicated, in/out axes following the base matrix
+    convention (train/lora.py lora_logical_axes, with the extra lane
+    axis)."""
+    base_axes = {
+        ("attn", "wq"): ("embed", "heads"),
+        ("attn", "wk"): ("embed", "kv_heads"),
+        ("attn", "wv"): ("embed", "kv_heads"),
+        ("attn", "wo"): ("heads", "embed"),
+        ("mlp", "wi_gate"): ("embed", "mlp"),
+        ("mlp", "wi_up"): ("embed", "mlp"),
+        ("mlp", "wi"): ("embed", "mlp"),
+        ("mlp", "wo"): ("mlp", "embed"),
+    }
+    axes: Dict[str, Dict[str, dict]] = {}
+    for group, sub in pool.items():
+        axes[group] = {}
+        for name in sub:
+            in_ax, out_ax = base_axes.get((group, name), (None, None))
+            axes[group][name] = {"a": (None, None, in_ax, None),
+                                 "b": (None, None, None, out_ax)}
+    return axes

@@ -139,6 +139,37 @@ def load_model(params: dict) -> Tuple[ModelConfig, Any]:
                 "no params")
         model_params = jax.jit(lambda r: init_params(cfg, r))(
             jax.random.key(params.get("seed", 0)))
+    # Baseline single-adapter path (docs/multi-tenant-lora.md): with the
+    # adapter POOL off, `adapter: <path>` folds the LoRA deltas into the
+    # base weights at load time (train/lora.py apply_lora) — one tenant,
+    # zero serve-time overhead, and the parity oracle the batched pooled
+    # path is tested against. Folding happens BEFORE quantization so the
+    # quantizer sees the merged weights; a pre-quantized checkpoint has
+    # no headroom to fold into and must use the pool instead.
+    adapter = params.get("adapter")
+    pool_raw = _param_any(params, "adapter_pool", "adapterPool",
+                          "adapterpool", default=0)
+    if adapter and int(pool_raw or 0):
+        # Ambiguous spec (controller validate_params rejects it; this
+        # guards hand-written params.json): folding would hard-wire ONE
+        # tenant into a pool meant for many, and silently ignoring the
+        # fold would serve the base model to clients expecting the
+        # adapter.
+        raise RuntimeError(
+            "params set both `adapter` and `adapter_pool`: the load-time "
+            "fold and the pooled engine are mutually exclusive serving "
+            "modes — drop `adapter` (clients pass it per request) or the "
+            "pool (docs/multi-tenant-lora.md)")
+    if adapter and not int(pool_raw or 0):
+        if tree_quantize_mode(model_params) != "none":
+            raise RuntimeError(
+                "cannot fold adapter into a pre-quantized checkpoint "
+                "(packed int8/int4 weights have no headroom); serve it "
+                "with adapter_pool >= 1 instead "
+                "(docs/multi-tenant-lora.md)")
+        from runbooks_tpu.serve.lora_pool import load_merge_adapter
+
+        model_params = load_merge_adapter(str(adapter), cfg, model_params)
     stored = tree_quantize_mode(model_params)
     if stored == "none" and quantize != "none":
         model_params = quantize_params(model_params, quantize)
@@ -235,10 +266,14 @@ class EngineWorker:
                     for req, fut in self._pending:
                         try:
                             self.engine.submit(req)
-                        except EngineOverloaded as exc:
+                        except (EngineOverloaded, ValueError) as exc:
                             # Race between the synchronous admission check
                             # and this enqueue: reject this request only,
                             # don't let it reach the crash catch-all.
+                            # ValueError covers validate() flipping
+                            # between the HTTP-thread check and here —
+                            # e.g. an adapter artifact deleted in the gap
+                            # (validation stats the filesystem).
                             if not fut.done():
                                 fut.set_exception(exc)
                             continue
@@ -310,7 +345,13 @@ class EngineWorker:
                         self._inflight = [(r, f) for r, f in self._inflight
                                           if not r.finished]
                 for req, fut in done:
-                    if req.auto_prefix and req._slot >= 0:
+                    # Adapter requests never seed the shared-prefix
+                    # cache: their slot KV was computed through the
+                    # tenant's LoRA deltas and must not serve base (or
+                    # other-tenant) prompts. (The paged engine's radix
+                    # adoption namespaces by adapter instead.)
+                    if req.auto_prefix and req._slot >= 0 \
+                            and req.adapter is None:
                         # Multi-turn chat: lift the prompt's KV out of
                         # the slot before the next admission can
                         # recycle it (safe here: admissions happen at
@@ -459,7 +500,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   speculative: Optional[str] = None,
                   draft_tokens: Optional[int] = None,
                   ngram_max: Optional[int] = None,
-                  ngram_min: Optional[int] = None) -> web.Application:
+                  ngram_min: Optional[int] = None,
+                  adapter_pool: Optional[int] = None,
+                  lora_rank: Optional[int] = None,
+                  adapter_dir: Optional[str] = None) -> web.Application:
     """max_queue bounds the admission queue (full -> HTTP 429 with
     Retry-After); request_timeout_s is the default per-request wall-clock
     deadline (body field "timeout" overrides per request; expiry finishes
@@ -479,7 +523,14 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     tokens per slot drafted from an n-gram index (ngram_max/ngram_min)
     over each request's own context and verified in one batched
     forward. None = follow the model config; greedy outputs are
-    token-for-token identical with speculation on or off."""
+    token-for-token identical with speculation on or off.
+
+    adapter_pool >= 1 (None = follow cfg.adapter_pool) turns on
+    multi-tenant batched LoRA serving (serve/lora_pool.py,
+    docs/multi-tenant-lora.md): per-request `adapter` names pin HBM
+    pool lanes at admission and heterogeneous tenants batch in one
+    dispatch. lora_rank is the static rank bucket; adapter_dir roots
+    relative adapter names (absolute paths pass through)."""
     if not request_timeout_s:
         # 0 disables, like the other *_s knobs — a validated config of 0
         # must mean "no deadline", not "400 every deadline-less request".
@@ -495,7 +546,9 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             prefix_cache_size=prefix_cache_size, max_queue=max_queue,
             page_size=page_size, num_pages=num_pages,
             speculative=speculative, draft_tokens=draft_tokens,
-            ngram_max=ngram_max, ngram_min=ngram_min)
+            ngram_max=ngram_max, ngram_min=ngram_min,
+            adapter_pool=adapter_pool, lora_rank=lora_rank,
+            adapter_dir=adapter_dir)
     else:
         engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
                                  max_seq_len=max_seq_len, mesh=mesh,
@@ -506,7 +559,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                                  speculative=speculative,
                                  draft_tokens=draft_tokens,
                                  ngram_max=ngram_max,
-                                 ngram_min=ngram_min)
+                                 ngram_min=ngram_min,
+                                 adapter_pool=adapter_pool,
+                                 lora_rank=lora_rank,
+                                 adapter_dir=adapter_dir)
     if warmup:
         # Pre-compile all buckets before readiness flips. warm_prefix
         # (params.json: warm_prefix) additionally compiles the prefix-KV
@@ -629,6 +685,34 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                             eng.spec_accepted,
                             help_text="Draft tokens verified-accepted "
                                       "by the batched verify forward.")
+        adapters = eng.adapter_stats()
+        if adapters is not None:
+            # Multi-tenant LoRA pool (serve/lora_pool.py,
+            # docs/multi-tenant-lora.md): residency churn + per-tenant
+            # request volume. Exported only by pooled engines, like the
+            # spec/page families above.
+            reg.set_counter("serve_adapter_loads_total",
+                            adapters["loads"],
+                            help_text="Adapters paged into the HBM pool "
+                                      "from artifact storage.")
+            reg.set_counter("serve_adapter_evictions_total",
+                            adapters["evictions"],
+                            help_text="Resident adapters displaced from "
+                                      "their pool lane (LRU, unpinned "
+                                      "lanes only).")
+            reg.set_counter("serve_adapter_hits_total",
+                            adapters["hits"],
+                            help_text="Adapter acquisitions served from "
+                                      "residency (no artifact read).")
+            reg.set_gauge("serve_adapters_resident",
+                          len(adapters["resident"]),
+                          help_text="Adapters currently resident in the "
+                                    "HBM pool.")
+            for name, count in adapters["requests"].items():
+                reg.set_counter(
+                    "serve_adapter_requests_total", count, adapter=name,
+                    help_text="Requests accepted per adapter name "
+                              "(base-model requests are not counted).")
         if occ.get("paged"):
             # Paged engine (serve/paging.py): page-pool pressure + radix
             # sharing, the per-PAGE extension of the admission-level hit
@@ -771,6 +855,9 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             # accept rate + decode tok/s per accept-rate bucket, so the
             # "is drafting paying on this traffic" question is one GET.
             "speculative": worker.engine.spec_stats(),
+            # Adapter-pool residency/churn (docs/multi-tenant-lora.md);
+            # None on pool-less engines.
+            "adapters": worker.engine.adapter_stats(),
             "compiles": {"total": sentinel.total,
                          "unexpected": sentinel.unexpected,
                          "compile_seconds": round(
@@ -882,6 +969,14 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             return None, web.json_response(
                 {"error": {"message": "timeout must be > 0 seconds"}},
                 status=400)
+        # Multi-tenant LoRA (docs/multi-tenant-lora.md): the adapter
+        # this request decodes through. Validated against the engine's
+        # pool at submit (pool off / unresolvable artifact -> 400).
+        adapter = body.get("adapter")
+        if adapter is not None and not isinstance(adapter, str):
+            return None, web.json_response(
+                {"error": {"message": "adapter must be a string"}},
+                status=400)
 
         tok = app_["tokenizer"]
         eos = _eos_id(tok)
@@ -890,7 +985,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             reqs.append(Request(
                 prompt_tokens=_encode(tok, p), max_tokens=max_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos, deadline_s=deadline))
+                eos_id=eos, deadline_s=deadline, adapter=adapter))
         return reqs, None
 
     async def _stream(app_, body, reqs, http_request, chat: bool = False,
@@ -1278,6 +1373,11 @@ def main() -> int:
         mesh = make_mesh(MeshConfig(**mesh_args))
 
     num_pages_raw = _param_any(params, "num_pages", "numPages", "numpages")
+    pool_raw = _param_any(params, "adapter_pool", "adapterPool",
+                          "adapterpool")
+    rank_raw = _param_any(params, "lora_rank", "loraRank", "lorarank")
+    adapter_dir_raw = _param_any(params, "adapter_dir", "adapterDir",
+                                 "adapterdir")
     draft_raw = _param_any(params, "draft_tokens", "draftTokens",
                            "drafttokens")
     ngram_max_raw = _param_any(params, "ngram_max", "ngramMax", "ngrammax")
@@ -1321,7 +1421,14 @@ def main() -> int:
                      if params.get("speculative") is not None else None),
         draft_tokens=int(draft_raw) if draft_raw is not None else None,
         ngram_max=int(ngram_max_raw) if ngram_max_raw is not None else None,
-        ngram_min=int(ngram_min_raw) if ngram_min_raw is not None else None)
+        ngram_min=int(ngram_min_raw) if ngram_min_raw is not None else None,
+        # Multi-tenant batched LoRA serving (docs/multi-tenant-lora.md):
+        # adapter_pool sizes the HBM adapter pool, lora_rank the static
+        # rank bucket, adapter_dir the root for relative adapter names.
+        # (A pool-less `adapter: <path>` already folded at load_model.)
+        adapter_pool=int(pool_raw) if pool_raw is not None else None,
+        lora_rank=int(rank_raw) if rank_raw is not None else None,
+        adapter_dir=str(adapter_dir_raw) if adapter_dir_raw else None)
     port = int(params.get("port", contract.SERVE_PORT))
 
     # Graceful drain on SIGTERM (docs/fault-tolerance.md): run_app's

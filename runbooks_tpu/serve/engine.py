@@ -138,6 +138,12 @@ class Request:
     # headers). Carried into the queue/prefill/decode span args so one
     # Perfetto trace follows this request end to end.
     request_id: str = ""
+    # Multi-tenant LoRA serving (serve/lora_pool.py,
+    # docs/multi-tenant-lora.md): name/path of the adapter this request
+    # decodes through, or None for the base model. Admission pins the
+    # adapter's pool lane (paging it into HBM if needed) and the slot
+    # carries the lane index into every batched dispatch.
+    adapter: Optional[str] = None
     # Filled by the engine:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
@@ -147,6 +153,7 @@ class Request:
     # — it runs inside the decode loop (SSE uses call_soon_threadsafe).
     on_token: Optional[Callable[[int], None]] = None
     _slot: int = -1
+    _adapter_lane: int = -1   # pool lane pinned at admission (-1 = base)
     _submitted: float = 0.0   # monotonic submit time (deadline anchor)
     _admitted: float = 0.0    # monotonic admission time (queue-wait end)
     _last_token_t: float = 0.0  # previous token's host-observed time
@@ -201,11 +208,17 @@ def make_prefill_fn(cfg: ModelConfig, cache_len: int):
     """Batched prefill + splice + first-token sample (one jit dispatch
     per admission group). See the inline commentary for the invariants;
     pk/pv (when given) splice a registered shared prefix into every
-    scratch row first."""
+    scratch row first.
+
+    apool/aslots (when given — engines with an adapter pool pass them on
+    EVERY dispatch): the stacked LoRA adapter pool and the per-row int32
+    lane indices (-1 = base-only, the all-zero trash lane). A batch
+    mixing tenants is one program; the lane values are operands
+    (docs/multi-tenant-lora.md)."""
 
     def prefill_fn(params, pool, tokens, positions, slots,
                    last_pos, rng, temps, top_ks, top_ps,
-                   pk=None, pv=None):
+                   pk=None, pv=None, apool=None, aslots=None):
         # Prefill `rows` requests into fresh zero rows at once, then
         # splice each row into the pool cache (donated => in-place, no
         # full-cache copy). Stale data from a slot's previous occupant
@@ -241,8 +254,10 @@ def make_prefill_fn(cfg: ModelConfig, cache_len: int):
             v1 = v1.at[:, :, :plen].set(
                 pv[:, None].astype(cfg.activation_dtype))
         cache1 = KVCache(k=k1, v=v1, index=jnp.zeros((), jnp.int32))
+        adapters = None if apool is None else (apool, aslots)
         logits, cache1 = forward(cfg, params, tokens,
-                                 positions=positions, cache=cache1)
+                                 positions=positions, cache=cache1,
+                                 adapters=adapters)
         if pool.k.dtype == jnp.int8:
             from runbooks_tpu.ops.quantization import quantize_kv
 
@@ -305,16 +320,18 @@ def make_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
     key returned) — no eager split on the host per chunk."""
 
     def decode_fn(params, cache, tokens, positions, rng,
-                  temperature, top_k, top_p, eos_ids, remaining, active):
+                  temperature, top_k, top_p, eos_ids, remaining, active,
+                  apool=None, aslots=None):
         rng, step_rng = jax.random.split(rng)
         keys = jax.random.split(step_rng, chunk)
+        adapters = None if apool is None else (apool, aslots)
 
         def body(carry, key):
             cache, tok, pos, alive, emitted = carry
             p = jnp.where(alive, pos, pad_slot)
             logits, cache = forward(cfg, params, tok[:, None],
                                     positions=p[:, None], cache=cache,
-                                    cache_view=view)
+                                    cache_view=view, adapters=adapters)
             nxt = sample(logits[:, -1], key, temperature, top_k, top_p)
             nxt = jnp.where(alive, nxt, tok)
             out = (nxt, alive)
@@ -357,12 +374,15 @@ def make_verify_fn(cfg: ModelConfig, draft_tokens: int, pad_slot: int,
     K = draft_tokens
 
     def verify_fn(params, cache, tokens, positions, draft_len, rng,
-                  temperature, top_k, top_p, active):
+                  temperature, top_k, top_p, active,
+                  apool=None, aslots=None):
         offs = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
         live = active[:, None] & (offs <= draft_len[:, None])
         pos = jnp.where(live, positions[:, None] + offs, pad_slot)
+        adapters = None if apool is None else (apool, aslots)
         logits, cache = forward(cfg, params, tokens, positions=pos,
-                                cache=cache, cache_view=view)
+                                cache=cache, cache_view=view,
+                                adapters=adapters)
         rng, sub = jax.random.split(rng)
         accept, resid, full = speculative_verify(
             logits, tokens[:, 1:], sub, temperature, top_k, top_p)
@@ -386,7 +406,10 @@ class InferenceEngine:
                  speculative: Optional[str] = None,
                  draft_tokens: Optional[int] = None,
                  ngram_max: Optional[int] = None,
-                 ngram_min: Optional[int] = None):
+                 ngram_min: Optional[int] = None,
+                 adapter_pool: Optional[int] = None,
+                 lora_rank: Optional[int] = None,
+                 adapter_dir: Optional[str] = None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
@@ -437,7 +460,19 @@ class InferenceEngine:
         draft-then-verify: a host-side prompt-lookup index proposes up
         to draft_tokens continuation tokens per slot and one [B, K+1]
         verify forward scores every slot's drafts at once; steps with no
-        draft anywhere fall back to the plain decode chunk."""
+        draft anywhere fall back to the plain decode chunk.
+
+        adapter_pool / lora_rank / adapter_dir: multi-tenant batched
+        LoRA serving (serve/lora_pool.py, docs/multi-tenant-lora.md).
+        adapter_pool > 0 (None = follow cfg.adapter_pool) keeps that
+        many LoRA adapters resident in HBM as a stacked pool and
+        compiles adapter-aware prefill/decode/verify programs; each
+        request's `adapter` name pins a pool lane at admission (paged in
+        from artifact storage on demand, LRU-evicted among unpinned
+        lanes) and base-only rows ride the all-zero trash lane, so
+        mixed-tenant traffic batches in ONE dispatch. lora_rank is the
+        static rank bucket every lane pads to; adapter_dir roots
+        relative adapter names."""
         self.cfg = cfg
         self.mesh = mesh
         self.prefill_budget = prefill_budget
@@ -519,6 +554,32 @@ class InferenceEngine:
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self._pad_slot = self.max_seq_len  # trash slot index
+        # Multi-tenant LoRA adapter pool (serve/lora_pool.py,
+        # docs/multi-tenant-lora.md): None when off — the engine then
+        # compiles the plain (adapter-free) program set and requests
+        # carrying an `adapter` 400 at validation.
+        pool_size = int(adapter_pool if adapter_pool is not None
+                        else cfg.adapter_pool)
+        self.adapters = None
+        if pool_size > 0:
+            from runbooks_tpu.serve.lora_pool import AdapterPool
+
+            self.adapters = AdapterPool(cfg, pool_size=pool_size,
+                                        rank=lora_rank, root=adapter_dir)
+            if mesh is not None:
+                from runbooks_tpu.ops.lora import \
+                    adapter_pool_logical_axes
+                from runbooks_tpu.parallel.sharding import tree_shardings
+
+                self.adapters.tree = jax.device_put(
+                    self.adapters.tree,
+                    tree_shardings(
+                        jax.eval_shape(lambda: self.adapters.tree),
+                        adapter_pool_logical_axes(self.adapters.tree),
+                        mesh))
+        # Per-slot adapter lane indices (-1 = base-only/trash lane): the
+        # operand every adapter-aware dispatch gathers A/B by.
+        self.adapter_slots = np.full(max_slots, -1, np.int32)
         self._init_cache()
         if self.prefill_budget is None:
             self.prefill_budget = self.max_seq_len
@@ -602,8 +663,8 @@ class InferenceEngine:
         # (plen, suffix-bucket, rows) shape; registrations are rare and
         # suffix buckets are the same bounded set as prefill buckets).
         self._prefill_prefix = jax.jit(
-            lambda params, pool, pk, pv, *rest: prefill_fn(
-                params, pool, *rest, pk=pk, pv=pv),
+            lambda params, pool, pk, pv, *rest, **kw: prefill_fn(
+                params, pool, *rest, pk=pk, pv=pv, **kw),
             donate_argnums=(1,))
         obs_device.PROGRAMS.register("serve", "prefill", self._prefill)
         obs_device.PROGRAMS.register("serve", "prefill_prefix",
@@ -670,6 +731,18 @@ class InferenceEngine:
                             v_scale=put(cache.v_scale))
         return cache
 
+    def _adapter_kwargs(self, aslots=None) -> dict:
+        """Extra operands for adapter-aware dispatches: the pool pytree
+        plus per-row lane indices (defaults to the per-slot lanes — the
+        decode/verify shape). {} when the pool is off, so the plain
+        program set stays untouched."""
+        if self.adapters is None:
+            return {}
+        if aslots is None:
+            aslots = self.adapter_slots
+        return {"apool": self.adapters.tree,
+                "aslots": jnp.asarray(aslots)}
+
     def _view_for(self, max_pos: int) -> int:
         """Smallest view bucket covering every query position this chunk
         can reach (caller passes max active length + chunk)."""
@@ -700,9 +773,10 @@ class InferenceEngine:
 
         capture_costs = _os.environ.get("RBT_DEVICE_OBS", "1") != "0"
 
-        def record_cost(name, sig, fn, *args):
+        def record_cost(name, sig, fn, *args, **kwargs):
             if capture_costs:
-                obs_device.program_cost("serve", name, sig, fn, *args)
+                obs_device.program_cost("serve", name, sig, fn, *args,
+                                        **kwargs)
 
         sentinel = obs_device.SENTINEL
         compiles_before = sentinel.total
@@ -713,6 +787,10 @@ class InferenceEngine:
         # already steady in this process (a trainer sharing it, a second
         # engine) they must not read as stalls.
         with sentinel.expected():
+            if self.adapters is not None:
+                # The pool's lane-splice program: an adapter paging in
+                # under traffic must reuse it, never compile.
+                self.adapters.warm()
             if prefix_build:
                 for bucket in self.prefill_buckets:
                     toks = np.zeros((1, bucket), np.int32)
@@ -734,14 +812,16 @@ class InferenceEngine:
                             jax.random.key(0), jnp.zeros(r, jnp.float32),
                             jnp.zeros(r, jnp.int32),
                             jnp.ones(r, jnp.float32))
+                    akw = self._adapter_kwargs(np.full(r, -1, np.int32))
                     with self._mesh_ctx():
                         record_cost("prefill", f"b{bucket}r{r}",
                                     self._prefill, self.params,
-                                    self.cache, *args)
+                                    self.cache, *args, **akw)
                         _, self.cache, _ = self._prefill(
-                            self.params, self.cache, *args)
+                            self.params, self.cache, *args, **akw)
                     n_prefill += 1
             zeros = np.zeros(self.max_slots, np.int32)
+            akw = self._adapter_kwargs()
             for view in self.view_buckets:
                 args = (jnp.asarray(zeros),
                         jnp.asarray(np.full(self.max_slots, self._pad_slot,
@@ -756,9 +836,9 @@ class InferenceEngine:
                 with self._mesh_ctx():
                     record_cost(f"decode_v{view}", f"v{view}",
                                 self._decode_for(view), self.params,
-                                self.cache, *args)
+                                self.cache, *args, **akw)
                     _, _, self.cache, _ = self._decode_for(view)(
-                        self.params, self.cache, *args)
+                        self.params, self.cache, *args, **akw)
             n_verify = 0
             if self.speculative != "off":
                 vtok = np.zeros((self.max_slots, self.draft_tokens + 1),
@@ -773,9 +853,9 @@ class InferenceEngine:
                     with self._mesh_ctx():
                         record_cost(f"verify_v{view}", f"v{view}",
                                     self._verify_for(view), self.params,
-                                    self.cache, *args)
+                                    self.cache, *args, **akw)
                         _, _, _, self.cache, _ = self._verify_for(view)(
-                            self.params, self.cache, *args)
+                            self.params, self.cache, *args, **akw)
                     n_verify += 1
         # Compiled-program census from the tracker (count + names +
         # compile seconds): model-config variants (collective_matmul,
@@ -793,6 +873,10 @@ class InferenceEngine:
             "verify_programs": n_verify,
             "speculative": self.speculative,
             "draft_tokens": self.draft_tokens,
+            "adapter_pool": (self.adapters.pool_size
+                             if self.adapters is not None else 0),
+            "lora_rank": (self.adapters.rank
+                          if self.adapters is not None else None),
             "compiles": sentinel.total - compiles_before,
             "compile_seconds": round(
                 sentinel.compile_seconds - seconds_before, 3),
@@ -981,7 +1065,8 @@ class InferenceEngine:
                 jnp.asarray(toks), jnp.asarray(positions),
                 jnp.zeros(rows, jnp.int32), jnp.zeros(rows, jnp.int32),
                 jax.random.key(0), jnp.zeros(rows, jnp.float32),
-                jnp.zeros(rows, jnp.int32), jnp.ones(rows, jnp.float32))
+                jnp.zeros(rows, jnp.int32), jnp.ones(rows, jnp.float32),
+                **self._adapter_kwargs(np.full(rows, -1, np.int32)))
         return buffers
 
     def _find_prefix(self, prompt: List[int]):
@@ -1002,6 +1087,16 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt of {len(req.prompt_tokens)} tokens exceeds the "
                 f"engine's context window ({self.max_seq_len})")
+        if req.adapter is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    "this server has no adapter pool (adapter_pool: 0); "
+                    "request-level `adapter` needs a pooled engine or a "
+                    "dedicated server with the adapter folded at load "
+                    "(docs/multi-tenant-lora.md)")
+            err = self.adapters.can_resolve(req.adapter)
+            if err is not None:
+                raise ValueError(err)
 
     def submit(self, req: Request) -> None:
         self.validate(req)
@@ -1009,6 +1104,8 @@ class InferenceEngine:
             raise EngineOverloaded(
                 f"admission queue full ({len(self.queue)} waiting, "
                 f"bound {self.max_queue}); retry later")
+        if req.adapter is not None and self.adapters is not None:
+            self.adapters.count_request(req.adapter)
         req._submitted = time.monotonic()
         self.queue.append(req)
 
@@ -1023,6 +1120,16 @@ class InferenceEngine:
         self.queue.clear()
         if self._spec_index is not None:
             self._spec_index.reset()
+        self._reset_adapters()
+
+    def _reset_adapters(self) -> None:
+        """Shared reset tail: every in-flight request is gone, so no
+        adapter lane stays pinned. Residency survives (the pool tree is
+        never donated to an engine step, so its buffers are valid even
+        after a crash) — the next admission hits instead of reloading."""
+        self.adapter_slots[:] = -1
+        if self.adapters is not None:
+            self.adapters.reset_refs()
 
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any())
@@ -1049,9 +1156,17 @@ class InferenceEngine:
         (one C-level op): the caller is usually an HTTP handler thread
         while the worker thread registers/evicts prefixes, and iterating
         the live dict mid-mutation raises."""
-        return {"weights": self.params,
-                "kv_cache": self.cache,
-                "prefix_cache": list(self._prefix_cache.copy().values())}
+        groups = {"weights": self.params,
+                  "kv_cache": self.cache,
+                  "prefix_cache": list(self._prefix_cache.copy().values())}
+        if self.adapters is not None:
+            groups["adapter_pool"] = self.adapters.tree
+        return groups
+
+    def adapter_stats(self) -> Optional[dict]:
+        """Adapter-pool snapshot for /metrics and /debug/programs
+        (docs/multi-tenant-lora.md); None when the pool is off."""
+        return None if self.adapters is None else self.adapters.stats()
 
     def _free_slots(self, exclude=()) -> List[int]:
         return [i for i in range(self.max_slots)
@@ -1059,6 +1174,33 @@ class InferenceEngine:
 
     def _bucket_for(self, n: int) -> int:
         return bucket_for(self.prefill_buckets, n)
+
+    def _acquire_adapter(self, req: Request) -> bool:
+        """Pin the request's adapter lane ahead of admission. True =
+        proceed (lane pinned, or no adapter involved); False = pool
+        exhausted, the caller stops admitting (queue backpressure). A
+        load failure (corrupt artifact) finishes the request with
+        finish_reason "error" and returns True with req.finished set —
+        the caller drops it from the queue."""
+        if req.adapter is None or self.adapters is None:
+            return True
+        if req._adapter_lane >= 0:
+            return True
+        from runbooks_tpu.serve.lora_pool import AdapterLoadError
+
+        try:
+            lane = self.adapters.acquire(req.adapter)
+        except AdapterLoadError as exc:
+            req.finished = True
+            req.finish_reason = "error"
+            print(f"serve: adapter {req.adapter!r} failed to load at "
+                  f"admission: {exc}", flush=True)
+            _observe_request_done(req, time.monotonic())
+            return True
+        if lane is None:
+            return False
+        req._adapter_lane = lane
+        return True
 
     def _admit(self, exclude_slots=()) -> None:
         budget = self.prefill_budget
@@ -1069,13 +1211,29 @@ class InferenceEngine:
             # Budget in bucket-padded tokens (what the prefill actually
             # computes — only the SUFFIX when a registered prefix covers
             # the front of the prompt). The first admission always goes
-            # through so an over-budget prompt cannot starve.
+            # through so an over-budget prompt cannot starve. Adapter
+            # requests never match shared prefixes: the cached prefix KV
+            # was computed with BASE weights, and a tenant's adapter
+            # changes the K/V projections themselves.
             head = self.queue[0]
-            pkey = self._find_prefix(head.prompt_tokens)
+            pkey = (None if head.adapter is not None
+                    else self._find_prefix(head.prompt_tokens))
             need = self._bucket_for(
                 len(head.prompt_tokens) - (len(pkey) if pkey else 0))
             if admitted and need > budget:
                 break
+            if not self._acquire_adapter(head):
+                # Every pool lane is pinned by an in-flight request: the
+                # head waits (FIFO) and the queue backs up until
+                # submit() sheds with the typed 429 — the same
+                # backpressure shape as the paged engine's page
+                # exhaustion (docs/multi-tenant-lora.md).
+                break
+            if head.finished:
+                # Adapter artifact failed to load: the request was
+                # finished with an error below; drop it and move on.
+                self.queue.pop(0)
+                continue
             req = self.queue.pop(0)
             req._admitted = time.monotonic()
             obs_metrics.REGISTRY.observe(
@@ -1144,15 +1302,18 @@ class InferenceEngine:
         temps = np.zeros(rows, np.float32)
         top_ks = np.zeros(rows, np.int32)
         top_ps = np.ones(rows, np.float32)
+        aslots = np.full(rows, -1, np.int32)
         for i, (_, req) in enumerate(group):
             last_pos[i] = len(req.prompt_tokens) - plen - 1
             temps[i] = req.temperature
             top_ks[i] = req.top_k
             top_ps[i] = req.top_p
+            aslots[i] = req._adapter_lane
         args = (jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(slots), jnp.asarray(last_pos), self.rng,
                 jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps))
+        akw = self._adapter_kwargs(aslots)
         # Dispatch timing is host-side, outside jit (the np.asarray pull
         # below is the device sync) — zero effect on compiled programs.
         t_dispatch = time.perf_counter()
@@ -1170,11 +1331,11 @@ class InferenceEngine:
                 pk, pv = self._prefix_cache[pkey]
                 self._prefix_cache_hit(pkey)
                 first, self.cache, self.rng = self._prefill_prefix(
-                    self.params, self.cache, pk, pv, *args)
+                    self.params, self.cache, pk, pv, *args, **akw)
                 self.prefix_tokens_reused += plen * n
             else:
                 first, self.cache, self.rng = self._prefill(
-                    self.params, self.cache, *args)
+                    self.params, self.cache, *args, **akw)
             # rbt-check: ignore[device-sync] prefill dispatch boundary — the first token must reach the host to stream
             first = np.asarray(first)
         # Labeled by (bucket, rows): the two row shapes are different
@@ -1201,6 +1362,7 @@ class InferenceEngine:
         self.lengths[slot] = len(req.prompt_tokens)
         self.last_token[slot] = first_tok
         self.slot_req[slot] = req
+        self.adapter_slots[slot] = req._adapter_lane
         req._slot = slot
         if self._spec_index is not None:
             self._spec_index.begin(slot, req.prompt_tokens)
@@ -1256,6 +1418,10 @@ class InferenceEngine:
         (serve/paging.py, which calls super())."""
         if self._spec_index is not None:
             self._spec_index.clear(slot)
+        self.adapter_slots[slot] = -1
+        if self.adapters is not None and req._adapter_lane >= 0:
+            self.adapters.release(req._adapter_lane)
+            req._adapter_lane = -1
 
     def _maybe_inject_fault(self) -> None:
         """RBT_FAULT_INJECT=engine:K hook, called at the top of step()
@@ -1290,6 +1456,12 @@ class InferenceEngine:
             if expired(r):
                 r.finished = True
                 r.finish_reason = "deadline"
+                # A queued request may already hold an adapter lane pin
+                # (acquired while waiting for a slot/pages): release it
+                # or the lane stays unEvictable forever.
+                if self.adapters is not None and r._adapter_lane >= 0:
+                    self.adapters.release(r._adapter_lane)
+                    r._adapter_lane = -1
                 _observe_request_done(r, now)
                 n += 1
             else:
@@ -1492,7 +1664,8 @@ class InferenceEngine:
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(positions), jnp.asarray(draft_len),
                     self.rng, jnp.asarray(temps), jnp.asarray(top_ks),
-                    jnp.asarray(top_ps), jnp.asarray(self.active))
+                    jnp.asarray(top_ps), jnp.asarray(self.active),
+                    **self._adapter_kwargs())
             # rbt-check: ignore[device-sync] verify dispatch boundary: one sync per verify step, not per token
             accept = np.asarray(accept)
             # rbt-check: ignore[device-sync] same boundary — resid rides the same verify sync
@@ -1549,7 +1722,7 @@ class InferenceEngine:
                 jnp.asarray(positions), self.rng,
                 jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
                 jnp.asarray(eos_ids), jnp.asarray(remaining),
-                jnp.asarray(self.active))
+                jnp.asarray(self.active), **self._adapter_kwargs())
             # rbt-check: ignore[device-sync] decode-chunk dispatch boundary: one sync per chunk, not per token
             toks = np.asarray(toks)          # [chunk, slots]
             # rbt-check: ignore[device-sync] same boundary — valid rides the same chunk sync

@@ -223,13 +223,34 @@ class RadixTree:
         self._clock += 1
         return self._clock
 
-    def match(self, tokens) -> List[int]:
+    def _root_for(self, ns, create: bool = False):
+        """Per-namespace subtree root. Namespaces isolate ADAPTERS
+        (docs/multi-tenant-lora.md): the same prompt tokens produce
+        different K/V under different LoRA adapters, so cross-tenant
+        page sharing would serve one tenant another's cache. The
+        namespace edge is a ("__adapter__", name) tuple — token edges
+        are all-int tuples, so no collision is possible. Stub nodes own
+        no page (page = -1) and are skipped by eviction; their count is
+        bounded by distinct adapters ever served."""
+        if ns is None:
+            return self.root
+        key = ("__adapter__", ns)
+        node = self.root.children.get(key)
+        if node is None and create:
+            node = _RadixNode(parent=self.root, edge=key, page=-1)
+            self.root.children[key] = node
+        return node
+
+    def match(self, tokens, ns=None) -> List[int]:
         """Physical pages for the longest full-page prefix of ``tokens``
-        present in the tree (possibly empty). Refreshes LRU recency on
-        the matched path. Does NOT take references — the caller increfs
-        when it commits to using the pages."""
+        present in the tree (possibly empty), within the ``ns`` adapter
+        namespace. Refreshes LRU recency on the matched path. Does NOT
+        take references — the caller increfs when it commits to using
+        the pages."""
         ps = self.page_size
-        node = self.root
+        node = self._root_for(ns)
+        if node is None:
+            return []
         pages: List[int] = []
         now = self._tick()
         for i in range(len(tokens) // ps):
@@ -241,14 +262,14 @@ class RadixTree:
             node = child
         return pages
 
-    def insert(self, tokens, pages) -> int:
+    def insert(self, tokens, pages, ns=None) -> int:
         """Adopt ``pages[i]`` as the shared page for the i-th full page
         of ``tokens``, for every position not already in the tree (the
         tree increfs adopted pages; an existing node keeps its page and
         the caller's duplicate stays private — it frees with the slot).
         Returns the number of pages adopted."""
         ps = self.page_size
-        node = self.root
+        node = self._root_for(ns, create=True)
         adopted = 0
         now = self._tick()
         for i in range(min(len(tokens) // ps, len(pages))):
@@ -270,7 +291,10 @@ class RadixTree:
         while stack:
             n = stack.pop()
             for c in n.children.values():
-                (stack if c.children else out).append(c)
+                if c.children:
+                    stack.append(c)
+                elif c.page >= 0:   # namespace stubs own no page
+                    out.append(c)
         return out
 
     def evict(self, want: int) -> int:
@@ -295,7 +319,8 @@ class RadixTree:
             # Refcounts can't move under us (eviction runs on the single
             # serving thread), so a pinned parent is skipped for good —
             # exactly the pin-before-evict contract _admit relies on.
-            if (p is not self.root and not p.children
+            # Namespace stubs (page < 0) never enter the heap.
+            if (p is not self.root and not p.children and p.page >= 0
                     and self.allocator.refcount(p.page) == 1):
                 heapq.heappush(heap, (p.last_used, id(p), p))
         self.pages_evicted += freed
@@ -388,7 +413,8 @@ def make_paged_prefill_fn(cfg: ModelConfig, cache_len: int,
 
     def paged_prefill_fn(params, pool, tokens, positions, dest_pages,
                          last_pos, rng, temps, top_ks, top_ps,
-                         prefix_pages=None, prefix_len=None):
+                         prefix_pages=None, prefix_len=None,
+                         apool=None, aslots=None):
         rows, _bucket = tokens.shape
         ad = cfg.activation_dtype
         quantized = pool.k.dtype == jnp.int8
@@ -428,8 +454,10 @@ def make_paged_prefill_fn(cfg: ModelConfig, cache_len: int,
             k1 = k1.at[:, r_idx, sp].set(gk)
             v1 = v1.at[:, r_idx, sp].set(gv)
         cache1 = KVCache(k=k1, v=v1, index=jnp.zeros((), jnp.int32))
+        adapters = None if apool is None else (apool, aslots)
         logits, cache1 = forward(cfg, params, tokens,
-                                 positions=positions, cache=cache1)
+                                 positions=positions, cache=cache1,
+                                 adapters=adapters)
 
         # Scatter the suffix K/V to the rows' private pages, by the same
         # positions operand the forward wrote them at. Pad tokens sit at
@@ -488,7 +516,7 @@ def make_paged_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
 
     def paged_decode_fn(params, pool, page_tables, tokens, positions, rng,
                         temperature, top_k, top_p, eos_ids, remaining,
-                        active):
+                        active, apool=None, aslots=None):
         B = tokens.shape[0]
         quantized = pool.k.dtype == jnp.int8
         flat_k = pool.k.reshape(L, n_flat, kvh, d)
@@ -512,12 +540,14 @@ def make_paged_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
         rng, step_rng = jax.random.split(rng)
         keys = jax.random.split(step_rng, chunk)
         b_idx = jnp.arange(B, dtype=jnp.int32)
+        adapters = None if apool is None else (apool, aslots)
 
         def body(carry, key):
             fk, fv, fks, fvs, cache, tok, pos, alive, emitted = carry
             p = jnp.where(alive, pos, V)   # park at the view trash slot
             logits, cache = forward(cfg, params, tok[:, None],
-                                    positions=p[:, None], cache=cache)
+                                    positions=p[:, None], cache=cache,
+                                    adapters=adapters)
             nxt = sample(logits[:, -1], key, temperature, top_k, top_p)
             nxt = jnp.where(alive, nxt, tok)
             # Write-back: the token the forward just wrote at p, view ->
@@ -584,7 +614,7 @@ def make_paged_verify_fn(cfg: ModelConfig, draft_tokens: int,
 
     def paged_verify_fn(params, pool, page_tables, tokens, positions,
                         draft_len, rng, temperature, top_k, top_p,
-                        active):
+                        active, apool=None, aslots=None):
         quantized = pool.k.dtype == jnp.int8
         flat_k = pool.k.reshape(L, n_flat, kvh, d)
         flat_v = pool.v.reshape(L, n_flat, kvh, d)
@@ -609,8 +639,9 @@ def make_paged_verify_fn(cfg: ModelConfig, draft_tokens: int,
         # Park dead lanes at the view trash slot V (the padded row the
         # gather appended) — same parking the paged decode scan uses.
         pos = jnp.where(live, positions[:, None] + offs, V)
+        adapters = None if apool is None else (apool, aslots)
         logits, vc = forward(cfg, params, tokens, positions=pos,
-                             cache=view_cache)
+                             cache=view_cache, adapters=adapters)
         # Write-back: every live position's freshly written K/V, view ->
         # physical page; parked lanes land in the pool trash page.
         idx5 = pos[None, :, :, None, None]
@@ -670,7 +701,7 @@ class PagedKVManager:
         self.pages_reused_total = 0   # radix hits, counted PER PAGE
 
     def plan(self, prompt_tokens, max_tokens: int,
-             max_seq_len: int) -> Tuple[List[int], int]:
+             max_seq_len: int, ns=None) -> Tuple[List[int], int]:
         """(shared_pages, private_needed) for admitting this prompt.
         Shared = the radix tree's longest full-page match, capped so at
         least one prompt token remains to prefill (sampling needs a real
@@ -682,7 +713,7 @@ class PagedKVManager:
         ps = self.page_size
         n = len(prompt_tokens)
         shareable = ((n - 1) // ps) * ps
-        shared = self.radix.match(prompt_tokens[:shareable])
+        shared = self.radix.match(prompt_tokens[:shareable], ns=ns)
         reserve = min(n + max_tokens, max_seq_len)
         total_pages = -(-reserve // ps)
         return shared, max(total_pages - len(shared), 0)
@@ -713,17 +744,19 @@ class PagedKVManager:
         self.pages_reused_total += len(shared)
         return priv
 
-    def release(self, slot: int, written_tokens=None) -> None:
+    def release(self, slot: int, written_tokens=None, ns=None) -> None:
         """Drop the slot's page references. With ``written_tokens`` (the
         finished request's prompt + generated tokens, trimmed to what
         the cache actually holds), first adopt the completed full pages
-        into the radix tree so the next prompt sharing this prefix —
-        including the next turn of the same chat — reuses them."""
+        into the radix tree — under the request's adapter namespace, so
+        a tenant's pages only ever serve the SAME adapter's prompts —
+        so the next prompt sharing this prefix (including the next turn
+        of the same chat) reuses them."""
         pages = self.slot_pages[slot]
         if not pages:
             return
         if written_tokens is not None:
-            self.radix.insert(written_tokens, pages)
+            self.radix.insert(written_tokens, pages, ns=ns)
         self.allocator.decref(pages)
         self.slot_pages[slot] = []
         self.slot_shared[slot] = 0
@@ -809,6 +842,7 @@ class PagedInferenceEngine(InferenceEngine):
         self.queue.clear()
         if self._spec_index is not None:
             self._spec_index.reset()
+        self._reset_adapters()
 
     # -- programs ------------------------------------------------------
 
@@ -882,9 +916,10 @@ class PagedInferenceEngine(InferenceEngine):
 
         capture_costs = _os.environ.get("RBT_DEVICE_OBS", "1") != "0"
 
-        def record_cost(name, sig, fn, *args):
+        def record_cost(name, sig, fn, *args, **kwargs):
             if capture_costs:
-                obs_device.program_cost("serve", name, sig, fn, *args)
+                obs_device.program_cost("serve", name, sig, fn, *args,
+                                        **kwargs)
 
         sentinel = obs_device.SENTINEL
         compiles_before = sentinel.total
@@ -896,6 +931,10 @@ class PagedInferenceEngine(InferenceEngine):
         n_prefill = 0
         trash = self.pager.trash_page
         with sentinel.expected():
+            if self.adapters is not None:
+                # The pool's lane-splice program (serve/lora_pool.py):
+                # adapter loads under traffic must never compile.
+                self.adapters.warm()
             for bucket, ppb in shapes:
                 for r in row_set:
                     tokens = np.zeros((r, bucket), np.int32)
@@ -913,15 +952,17 @@ class PagedInferenceEngine(InferenceEngine):
                         args = args + (
                             jnp.full((r, ppb), trash, jnp.int32),
                             jnp.zeros(r, jnp.int32))
+                    akw = self._adapter_kwargs(np.full(r, -1, np.int32))
                     record_cost("paged_prefill", f"b{bucket}r{r}p{ppb}",
                                 self._paged_prefill, self.params,
-                                self.cache, *args)
+                                self.cache, *args, **akw)
                     _, self.cache, _ = self._paged_prefill(
-                        self.params, self.cache, *args)
+                        self.params, self.cache, *args, **akw)
                     n_prefill += 1
             zeros = np.zeros(self.max_slots, np.int32)
             tables = np.full((self.max_slots, self.pages_per_slot), trash,
                              np.int32)
+            akw = self._adapter_kwargs()
             for vp in self.view_page_buckets:
                 args = (jnp.asarray(tables), jnp.asarray(zeros),
                         jnp.asarray(zeros), jax.random.key(0),
@@ -933,9 +974,9 @@ class PagedInferenceEngine(InferenceEngine):
                         jnp.zeros(self.max_slots, bool))
                 record_cost(f"decode_p{vp}", f"p{vp}",
                             self._decode_for(vp), self.params,
-                            self.cache, *args)
+                            self.cache, *args, **akw)
                 _, _, self.cache, _ = self._decode_for(vp)(
-                    self.params, self.cache, *args)
+                    self.params, self.cache, *args, **akw)
             n_verify = 0
             if self.speculative != "off":
                 vtok = np.zeros((self.max_slots, self.draft_tokens + 1),
@@ -950,9 +991,9 @@ class PagedInferenceEngine(InferenceEngine):
                             jnp.zeros(self.max_slots, bool))
                     record_cost(f"verify_p{vp}", f"p{vp}",
                                 self._verify_for(vp), self.params,
-                                self.cache, *args)
+                                self.cache, *args, **akw)
                     _, _, _, self.cache, _ = self._verify_for(vp)(
-                        self.params, self.cache, *args)
+                        self.params, self.cache, *args, **akw)
                     n_verify += 1
         census = obs_device.PROGRAMS.census("serve")
         self.warmup_census = {
@@ -967,6 +1008,10 @@ class PagedInferenceEngine(InferenceEngine):
             "verify_programs": n_verify,
             "speculative": self.speculative,
             "draft_tokens": self.draft_tokens,
+            "adapter_pool": (self.adapters.pool_size
+                             if self.adapters is not None else 0),
+            "lora_rank": (self.adapters.rank
+                          if self.adapters is not None else None),
             "compiles": sentinel.total - compiles_before,
             "compile_seconds": round(
                 sentinel.compile_seconds - seconds_before, 3),
@@ -1074,19 +1119,33 @@ class PagedInferenceEngine(InferenceEngine):
             if not self.queue:
                 break
             head = self.queue[0]
+            # Radix lookups are namespaced by adapter: a tenant's pages
+            # only ever match the SAME adapter's prompts (the K/V values
+            # differ per adapter even for identical tokens).
             shared, private_n = self.pager.plan(
-                head.prompt_tokens, head.max_tokens, self.max_seq_len)
+                head.prompt_tokens, head.max_tokens, self.max_seq_len,
+                ns=head.adapter)
             suffix = (len(head.prompt_tokens)
                       - len(shared) * self.page_size)
             need = self._bucket_for(suffix)
             if admitted and need > budget:
                 break
+            if not self._acquire_adapter(head):
+                # Adapter-pool exhaustion: same backpressure as page
+                # exhaustion below — the head waits, the queue backs up,
+                # submit() sheds with 429.
+                break
+            if head.finished:       # adapter artifact failed to load
+                self.queue.pop(0)
+                continue
             priv = self.pager.admit(slot, shared, private_n)
             if priv is None:
                 # Page pressure even after evicting unreferenced prefix
                 # pages: the head waits (FIFO — no starvation of big
                 # requests) and the queue backs up until submit() sheds
                 # with 429. Never admit a request the pool cannot hold.
+                # (The adapter lane pin above persists on the request
+                # and is reused when pages free up.)
                 break
             req = self.queue.pop(0)
             req._admitted = time.monotonic()
@@ -1135,7 +1194,9 @@ class PagedInferenceEngine(InferenceEngine):
         temps = np.zeros(rows, np.float32)
         top_ks = np.zeros(rows, np.int32)
         top_ps = np.ones(rows, np.float32)
+        aslots = np.full(rows, -1, np.int32)
         for i, (slot, req) in enumerate(group):
+            aslots[i] = req._adapter_lane
             nshared = int(self.pager.slot_shared[slot])
             plen = nshared * ps
             m = len(req.prompt_tokens) - plen
@@ -1167,7 +1228,8 @@ class PagedInferenceEngine(InferenceEngine):
                   prefix=ppb * ps, **attrs), \
                 self._mesh_ctx():
             first, self.cache, self.rng = self._paged_prefill(
-                self.params, self.cache, *args)
+                self.params, self.cache, *args,
+                **self._adapter_kwargs(aslots))
             # rbt-check: ignore[device-sync] prefill dispatch boundary — the first token must reach the host to stream
             first = np.asarray(first)
         obs_metrics.REGISTRY.observe(
@@ -1191,8 +1253,8 @@ class PagedInferenceEngine(InferenceEngine):
         m = len(req.output_tokens)
         written = len(req.prompt_tokens) + max(0, m - 1)
         toks = (req.prompt_tokens + req.output_tokens)[:written]
-        self.pager.release(slot, written_tokens=toks)
-        super()._on_slot_finished(slot, req)  # speculative index clear
+        self.pager.release(slot, written_tokens=toks, ns=req.adapter)
+        super()._on_slot_finished(slot, req)  # spec index + adapter lane
 
     # -- decode --------------------------------------------------------
 
@@ -1214,7 +1276,8 @@ class PagedInferenceEngine(InferenceEngine):
                     jnp.asarray(tokens), jnp.asarray(positions),
                     jnp.asarray(draft_len), self.rng,
                     jnp.asarray(temps), jnp.asarray(top_ks),
-                    jnp.asarray(top_ps), jnp.asarray(self.active))
+                    jnp.asarray(top_ps), jnp.asarray(self.active),
+                    **self._adapter_kwargs())
             # rbt-check: ignore[device-sync] verify dispatch boundary: one sync per verify step, not per token
             accept = np.asarray(accept)
             # rbt-check: ignore[device-sync] same boundary — resid rides the same verify sync
@@ -1250,7 +1313,8 @@ class PagedInferenceEngine(InferenceEngine):
                 jnp.asarray(self.last_token), jnp.asarray(positions),
                 self.rng, jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps), jnp.asarray(eos_ids),
-                jnp.asarray(remaining), jnp.asarray(self.active))
+                jnp.asarray(remaining), jnp.asarray(self.active),
+                **self._adapter_kwargs())
             # rbt-check: ignore[device-sync] decode-chunk dispatch boundary: one sync per chunk, not per token
             toks = np.asarray(toks)
             # rbt-check: ignore[device-sync] same boundary — valid rides the same chunk sync
