@@ -36,6 +36,23 @@ routed request for both. Acceptance: prefix-aware routing reuses
 >= 1.5x the pages per request (value = uplift, vs_baseline =
 uplift / 1.5) with zero unexpected XLA compiles throughout.
 
+RBT_BENCH_MESH_SERVE=1 runs the sharded-serving-mesh axis
+(docs/tensor-parallel-performance.md "Sharded serving"): the same
+shared-prefix paged workload on a single device, then on a
+mesh_tensor=K serving mesh (K from RBT_BENCH_MESH_TENSOR, default 2 —
+benchkit virtualizes that many CPU devices on the fallback), reporting
+decode tok/s for both AND the max-fit model multiplier: per-chip
+weights+KV bytes single-device over per-chip bytes under the mesh —
+i.e. how much more model one chip's HBM bound admits when the replica
+shards. Acceptance at K=2: >= 1.6x (weights and the kv-head-sharded
+pool split ~2x; replicated norms/host state cap it below 2), value =
+multiplier, vs_baseline = multiplier / 1.6, forced to 0 on any
+unexpected compile in the mesh steady loop. Greedy outputs vs
+single-device are reported (greedy_token_mismatches) but not gated:
+at bf16 serving precision GSPMD's sharded partial-sum order can flip
+an argmax tie — byte-exact parity is asserted where it is a theorem,
+in tests/test_mesh_serving.py under pinned exact precision.
+
 RBT_BENCH_LORA=1 runs the multi-tenant LoRA density axis
 (docs/multi-tenant-lora.md): N adapters on ONE pooled engine vs N
 dedicated merged-weights engines serving the same workload, reporting
@@ -196,6 +213,116 @@ def paged_inner() -> None:
         "pages_shared": occ["pages_shared"],
         "pages_evicted_total": occ["pages_evicted_total"],
         "unexpected_compiles_steady_loop": unexpected,
+        "platform": jax.default_backend(),
+        "device": str(device),
+    }))
+
+
+def mesh_serve_inner() -> None:
+    """Sharded serving mesh: decode tok/s + max-fit multiplier,
+    mesh_tensor=K vs single device on the shared-prefix paged workload.
+
+    The max-fit multiplier is the HBM claim made concrete: per-chip
+    bytes (weights + KV pool, measured from actual shard shapes) on one
+    device divided by per-chip bytes under the mesh. That ratio is how
+    much bigger a model the same chip HBM serves when one replica spans
+    K chips — the reason the mesh exists."""
+    import jax
+    import numpy as np
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.obs import device as obs_device
+    from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+    from runbooks_tpu.serve.engine import Request
+    from runbooks_tpu.serve.paging import PagedInferenceEngine
+
+    device = jax.devices()[0]
+    on_tpu = ("tpu" in jax.default_backend().lower()
+              or "TPU" in str(device))
+    model = os.environ.get("RBT_BENCH_MODEL",
+                           "bench-410m" if on_tpu else "debug")
+    tp = int(os.environ.get("RBT_BENCH_MESH_TENSOR", 2))
+    if len(jax.devices()) < tp:
+        raise RuntimeError(
+            f"mesh serve axis needs {tp} devices, have "
+            f"{len(jax.devices())} (CPU: benchkit's fallback sets "
+            f"--xla_force_host_platform_device_count from "
+            f"RBT_BENCH_MESH_TENSOR)")
+    slots = int(os.environ.get("RBT_BENCH_SLOTS", 4))
+    max_seq = int(os.environ.get("RBT_BENCH_MAXSEQ", 128))
+    page_size = int(os.environ.get("RBT_BENCH_PAGE_SIZE", 16))
+    prompt_len = int(os.environ.get("RBT_BENCH_PROMPT", 64))
+    prefix_len = int(os.environ.get("RBT_BENCH_PREFIX", 48))
+    max_tokens = int(os.environ.get("RBT_BENCH_MAXTOK", 16))
+    n_requests = 2 * slots
+
+    cfg = get_config(model, param_dtype="bfloat16")
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, prefix_len).tolist()
+    prompts = [shared + rng.integers(
+        1, cfg.vocab_size, prompt_len - prefix_len).tolist()
+        for _ in range(n_requests)]
+
+    def run(mesh):
+        engine = PagedInferenceEngine(
+            cfg, params, max_slots=slots, max_seq_len=max_seq,
+            page_size=page_size, max_queue=n_requests, mesh=mesh)
+        engine.register_prefix(shared)
+        engine.warmup()
+        reqs = [Request(prompt_tokens=list(p), max_tokens=max_tokens,
+                        temperature=0.0) for p in prompts]
+        for r in reqs:
+            engine.submit(r)
+        unexpected_before = obs_device.SENTINEL.unexpected
+        t0 = time.perf_counter()
+        for _ in range(200000):
+            engine.step()
+            if all(r.finished for r in reqs):
+                break
+        else:
+            raise RuntimeError("mesh bench workload did not converge")
+        wall = time.perf_counter() - t0
+        unexpected = obs_device.SENTINEL.unexpected - unexpected_before
+        toks = sum(len(r.output_tokens) for r in reqs)
+        weights_local = sum(
+            obs_device.shard_local_nbytes(a)
+            for a in jax.tree.leaves(engine.params))
+        occ = engine.kv_occupancy()
+        per_chip = weights_local + occ["kv_pool_bytes_per_device"]
+        outputs = [list(r.output_tokens) for r in reqs]
+        engine.release_steady()
+        return outputs, toks / wall, per_chip, unexpected
+
+    single_out, single_tps, single_chip_bytes, single_unexpected = \
+        run(None)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, tensor=tp))
+    mesh_out, mesh_tps, mesh_chip_bytes, mesh_unexpected = run(mesh)
+
+    # Informational, not gated: at bf16 the sharded partial-sum order
+    # can flip an argmax tie. The byte-exact parity claim lives in
+    # tests/test_mesh_serving.py (pinned exact matmul precision).
+    mismatches = sum(a != b for a, b in zip(single_out, mesh_out))
+    multiplier = single_chip_bytes / mesh_chip_bytes
+    gated = mesh_unexpected > 0
+    print(json.dumps({
+        "metric": f"{model} mesh_tensor={tp} serving max-fit model "
+                  f"footprint vs single chip ({n_requests} reqs, "
+                  f"prompt {prompt_len}, page_size {page_size})",
+        "value": round(multiplier, 2),
+        "unit": "x",
+        # Acceptance >= 1.6x at tensor=2 (see module docstring), so
+        # > 1.0 here means the claim holds.
+        "vs_baseline": 0.0 if gated else round(multiplier / 1.6, 4),
+        "mesh_tensor": tp,
+        "single_decode_tokens_per_sec": round(single_tps, 1),
+        "mesh_decode_tokens_per_sec": round(mesh_tps, 1),
+        "single_per_chip_bytes": int(single_chip_bytes),
+        "mesh_per_chip_bytes": int(mesh_chip_bytes),
+        "greedy_token_mismatches": mismatches,
+        "unexpected_compiles_steady_loop": (single_unexpected
+                                            + mesh_unexpected),
         "platform": jax.default_backend(),
         "device": str(device),
     }))
@@ -787,8 +914,11 @@ if __name__ == "__main__":
     router_axis = os.environ.get("RBT_BENCH_ROUTER") == "1"
     spec_axis = os.environ.get("RBT_BENCH_SPEC") == "1"
     lora_axis = os.environ.get("RBT_BENCH_LORA") == "1"
+    mesh_axis = os.environ.get("RBT_BENCH_MESH_SERVE") == "1"
     if "--inner" in sys.argv:
-        if lora_axis:
+        if mesh_axis:
+            mesh_serve_inner()
+        elif lora_axis:
             lora_inner()
         elif spec_axis:
             spec_inner()
@@ -802,7 +932,8 @@ if __name__ == "__main__":
         import benchkit
         benchkit.run_outer(
             os.path.abspath(__file__),
-            *(("LoRA tenant density vs dedicated", "x") if lora_axis
+            *(("mesh serving max-fit vs single chip", "x") if mesh_axis
+              else ("LoRA tenant density vs dedicated", "x") if lora_axis
               else ("speculative decode vs spec-off", "x") if spec_axis
               else ("prefix-aware vs random routing", "x") if router_axis
               else ("paged KV concurrency vs dense", "x") if paged_axis

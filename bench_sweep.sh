@@ -162,6 +162,17 @@ run serve-spec env RBT_BENCH_SPEC=1 python bench_serve.py
 #      asserted inline against every dedicated engine.
 run serve-lora env RBT_BENCH_LORA=1 python bench_serve.py
 
+# 4a6. Sharded serving mesh (docs/tensor-parallel-performance.md
+#      "Sharded serving"): the shared-prefix paged workload single-
+#      device vs a mesh_tensor=2 replica — value is the max-fit model
+#      multiplier (per-chip weights+KV bytes, single over mesh;
+#      acceptance >= 1.6x at tensor=2, vs_baseline = multiplier/1.6,
+#      forced to 0 on any unexpected compile in the mesh steady loop),
+#      with decode tok/s for both and the informational greedy-token
+#      mismatch count in the same JSON line.
+run serve-mesh env RBT_BENCH_MESH_SERVE=1 RBT_BENCH_MESH_TENSOR=2 \
+  python bench_serve.py
+
 # 4b. Observability instrumentation overhead (docs/observability.md):
 #     the per-step cost of the obs subsystem (spans + histogram observes +
 #     goodput update) as a percent of the real step time, PLUS the fleet-

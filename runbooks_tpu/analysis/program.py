@@ -387,7 +387,7 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         return paged_verify(params_, pool_, *rest, apool=apool_,
                             aslots=aslots_)
 
-    return [
+    specs = [
         {"component": "serve", "name": "prefill", "fn": prefill,
          "args": prefill_args(rows_set[-1], buckets[-1]),
          "signatures": len(buckets) * len(rows_set)},
@@ -443,6 +443,74 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
                   + paged_verify_args[2:]),
          "signatures": len(vp_buckets)},
     ]
+
+    # Sharded serving mesh (docs/tensor-parallel-performance.md): under a
+    # mesh_tensor > 1 mesh the SAME factories trace DIFFERENT programs —
+    # resolve_collective_matmul flips the ring path on at trace time — so
+    # the sharded decode path gets its own census rows, traced under a
+    # real tensor=2 mesh exactly as the engine's warmup does. Signature
+    # cardinality mirrors the unsharded counterparts (a mesh engine
+    # compiles the same bucket walk, just different programs). Skipped
+    # below 2 devices; the canonical check env (Makefile TEST_ENV) pins 8
+    # virtual CPU devices, so the committed baseline always carries them.
+    if len(jax.devices()) >= 2:
+        import dataclasses as _dc
+
+        from runbooks_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(data=1, fsdp=-1, tensor=2))
+        cfg_tp = _dc.replace(cfg, collective_matmul="auto")
+
+        def under_mesh(fn):
+            def wrapped(*args):
+                with jax.set_mesh(mesh):
+                    return fn(*args)
+            return wrapped
+
+        prefill_tp = make_prefill_fn(cfg_tp, cache_len)
+        decode_tp = make_decode_fn(cfg_tp, settings.decode_chunk,
+                                   max_seq_len, max_seq_len, views[-1])
+        verify_tp = make_verify_fn(cfg_tp, K, max_seq_len, views[-1])
+        paged_prefill_tp = make_paged_prefill_fn(cfg_tp, cache_len,
+                                                 page_size, pool_pages)
+        paged_decode_tp = make_paged_decode_fn(
+            cfg_tp, settings.decode_chunk, max_seq_len, page_size,
+            vp_buckets[-1], pool_pages)
+        paged_verify_tp = make_paged_verify_fn(cfg_tp, K, page_size,
+                                               vp_buckets[-1], pool_pages)
+
+        def adapter_decode_tp(params_, pool_, apool_, aslots_, *rest):
+            return decode_tp(params_, pool_, *rest, apool=apool_,
+                             aslots=aslots_)
+
+        specs += [
+            {"component": "serve", "name": "prefill_sharded",
+             "fn": under_mesh(prefill_tp),
+             "args": prefill_args(rows_set[-1], buckets[-1]),
+             "signatures": len(buckets) * len(rows_set)},
+            {"component": "serve", "name": "decode_sharded",
+             "fn": under_mesh(decode_tp), "args": decode_args,
+             "signatures": len(views)},
+            {"component": "serve", "name": "verify_sharded",
+             "fn": under_mesh(verify_tp), "args": verify_args,
+             "signatures": len(views)},
+            {"component": "serve", "name": "paged_prefill_sharded",
+             "fn": under_mesh(paged_prefill_tp),
+             "args": paged_prefill_args,
+             "signatures": len(pshapes) * len(rows_set)},
+            {"component": "serve", "name": "paged_decode_sharded",
+             "fn": under_mesh(paged_decode_tp),
+             "args": paged_decode_args, "signatures": len(vp_buckets)},
+            {"component": "serve", "name": "paged_verify_sharded",
+             "fn": under_mesh(paged_verify_tp),
+             "args": paged_verify_args, "signatures": len(vp_buckets)},
+            {"component": "serve", "name": "adapter_decode_sharded",
+             "fn": under_mesh(adapter_decode_tp),
+             "args": ([params, pool, apool, aslots_sds(slots)]
+                      + decode_args[2:]),
+             "signatures": len(views)},
+        ]
+    return specs
 
 
 def _train_specs(settings: AuditSettings) -> List[dict]:

@@ -126,6 +126,12 @@ _ADAPTER_POOL_KEYS = ("adapter_pool", "adapterPool", "adapterpool")
 _LORA_RANK_KEYS = ("lora_rank", "loraRank", "lorarank")
 _ADAPTER_DIR_KEYS = ("adapter_dir", "adapterDir", "adapterdir")
 
+# Mesh geometry axes (parallel/mesh.py MESH_AXES — keep in sync like
+# DEFAULT_NGRAM_MAX; not imported so the controller stays jax-free). A
+# spec selects sharded serving/training with mesh_<axis> integer params;
+# -1 means "fill with the remaining devices" on at most ONE axis.
+_MESH_AXES = ("data", "stage", "expert", "fsdp", "sequence", "tensor")
+
 INT_PARAMS = {
     "loss_chunk": 0,
     "prefetch_depth": 0,
@@ -349,6 +355,30 @@ def validate_params(params: dict) -> Optional[str]:
                 "the pool serves per-request adapters; point tenant "
                 "Servers at this pool via spec.engineRef instead "
                 "(docs/multi-tenant-lora.md)")
+    # Mesh geometry (parallel/mesh.py): mesh_<axis> params select a
+    # sharded engine. An unknown axis name is a typo the workload would
+    # silently ignore (serving a single chip while the spec says eight);
+    # more than one -1 fill axis is ambiguous and MeshConfig would
+    # crash-loop the replica on it.
+    fill_axes = []
+    for key in sorted(k for k in params if k.startswith("mesh_")):
+        axis = key[len("mesh_"):]
+        if axis not in _MESH_AXES:
+            return (f"spec.params.{key}: unknown mesh axis (expected "
+                    f"mesh_<axis> with axis one of "
+                    f"{'|'.join(_MESH_AXES)})")
+        try:
+            size = int(params[key])
+        except (TypeError, ValueError):
+            return f"spec.params.{key}: {params[key]!r} is not an integer"
+        if size == -1:
+            fill_axes.append(key)
+        elif size < 1:
+            return (f"spec.params.{key}: {size} must be >= 1 (or -1 to "
+                    "fill with the remaining devices)")
+    if len(fill_axes) > 1:
+        return ("spec.params: at most one mesh axis may be -1 (fill), "
+                f"got {', '.join(fill_axes)}")
     accum = next((params[k] for k in _ACCUM_KEYS
                   if params.get(k) is not None), None)
     if accum is not None:

@@ -13,6 +13,7 @@ multi-host serving engine).
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 import urllib.request
@@ -144,12 +145,48 @@ class _IncidentBook:
 INCIDENTS = _IncidentBook()
 
 
+def _validate_serve_mesh(server: Server) -> Optional[str]:
+    """Serve-specific mesh-geometry checks (validate_params already vetted
+    the per-axis values for every workload kind). A serving replica is ONE
+    process: pipeline stages are a training-only axis, and a mesh must fit
+    the chips of a single-host slice — both would otherwise crash-loop the
+    Deployment at engine construction instead of surfacing a condition."""
+    params = server.params
+    sizes = {k: int(params[k]) for k in params if k.startswith("mesh_")}
+    if sizes.get("mesh_stage", 1) > 1:
+        return ("spec.params.mesh_stage: pipeline stages are a training "
+                "axis; the serving engine is one process per replica "
+                "(docs/tensor-parallel-performance.md)")
+    if not server.tpu:
+        return None
+    try:
+        slice_ = parse_tpu(server.tpu)
+    except ValueError as exc:
+        return f"spec.resources.tpu: {exc}"
+    if not sizes:
+        return None
+    if slice_.multi_host:
+        return (f"spec.resources.tpu: topology {slice_.topology} spans "
+                f"{slice_.hosts} hosts, but a mesh-sharded serving "
+                f"replica is one process; pick a single-host topology "
+                f"(<= {slice_.chips_per_host} chips for {slice_.type})")
+    if any(s == -1 for s in sizes.values()):
+        return None  # the fill axis adapts to whatever the slice provides
+    product = math.prod(sizes.values())
+    if product != slice_.chips:
+        return (f"spec.params: mesh axes multiply to {product} chips but "
+                f"tpu topology {slice_.topology} provides {slice_.chips}; "
+                "make the products match, or set one axis to -1 to fill")
+    return None
+
+
 class ServerReconciler:
     kind = "Server"
 
     def reconcile(self, ctx: Ctx, raw: dict) -> Result:
         server = Server(raw)
         err = validate_params(server.params) \
+            or _validate_serve_mesh(server) \
             or validate_slo(server.spec.get("slo")) \
             or validate_gateway(server.spec.get("gateway")) \
             or validate_autoscale(server.spec.get("autoscale"))

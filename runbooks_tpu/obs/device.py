@@ -508,6 +508,23 @@ def set_memory_gauges(registry: Optional[obs_metrics.Registry] = None
     return entries
 
 
+def shard_local_nbytes(arr) -> int:
+    """Per-device bytes one device holds of ``arr`` under its sharding.
+    Pure metadata (``sharding.shard_shape`` — no device sync, no
+    transfer): a [H, D] weight sharded 2-way over its head axis reports
+    half its logical ``nbytes``; replicated and single-device arrays
+    report the full amount. Falls back to logical bytes when the
+    sharding doesn't expose shard shapes (committed host arrays etc.)."""
+    try:
+        shape = arr.sharding.shard_shape(tuple(arr.shape))
+        out = int(getattr(arr.dtype, "itemsize", 1))
+        for d in shape:
+            out *= int(d)
+        return out
+    except Exception:  # noqa: BLE001 — metadata probe only
+        return int(getattr(arr, "nbytes", 0))
+
+
 def _tree_array_ids(tree: Any) -> set:
     """ids of the jax.Array leaves of an arbitrary pytree (QuantizedArray,
     KVCache etc. are registered pytrees, so tree.leaves walks them)."""
@@ -528,14 +545,22 @@ def live_array_census(groups: Optional[Dict[str, Any]] = None) -> dict:
     belongs to no group lands in ``other``. Bytes are logical
     (``nbytes``); a group's number is exact, the categories + ``other``
     sum to ``total_bytes`` by construction. Deleted (donated-away)
-    arrays are skipped — they hold no memory."""
+    arrays are skipped — they hold no memory.
+
+    ``by_category_per_device`` / ``total_per_device_bytes`` carry the
+    same attribution in PER-DEVICE bytes (shard_local_nbytes): under a
+    serving mesh a sharded weight or KV pool costs each chip only its
+    shard, and per-chip HBM — not the logical total — is what fits or
+    OOMs. On one device (or fully replicated) the two views agree."""
     import jax
 
     group_ids = {name: _tree_array_ids(tree)
                  for name, tree in (groups or {}).items()}
     by_group = {name: 0 for name in group_ids}
+    by_group_local = {name: 0 for name in group_ids}
     by_group_counts = {name: 0 for name in group_ids}
     total = 0
+    total_local = 0
     count = 0
     for arr in jax.live_arrays():
         try:
@@ -544,19 +569,25 @@ def live_array_census(groups: Optional[Dict[str, Any]] = None) -> dict:
             nbytes = int(arr.nbytes)
         except Exception:  # noqa: BLE001 — racing a deletion
             continue
+        local = shard_local_nbytes(arr)
         total += nbytes
+        total_local += local
         count += 1
         aid = id(arr)
         for name, ids in group_ids.items():
             if aid in ids:
                 by_group[name] += nbytes
+                by_group_local[name] += local
                 by_group_counts[name] += 1
                 break
     categorized = sum(by_group.values())
     by_group["other"] = total - categorized
+    by_group_local["other"] = total_local - sum(by_group_local.values())
     by_group_counts["other"] = count - sum(by_group_counts.values())
     return {"total_bytes": total, "arrays": count,
+            "total_per_device_bytes": total_local,
             "by_category": by_group,
+            "by_category_per_device": by_group_local,
             "array_counts": by_group_counts}
 
 

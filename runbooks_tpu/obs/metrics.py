@@ -121,6 +121,10 @@ CATALOG: Dict[str, str] = {
     "serve_kv_cache_tokens": "gauge",
     "serve_kv_cache_capacity_tokens": "gauge",
     "serve_kv_occupancy_ratio": "gauge",
+    # KV pool HBM bytes: aggregate (logical) and per-device (the shard
+    # each chip holds under a serving mesh; equal unsharded)
+    "serve_kv_pool_bytes": "gauge",
+    "serve_kv_pool_bytes_per_device": "gauge",
     "serve_prefix_lookups_total": "counter",
     "serve_prefix_hits_total": "counter",
     # Paged KV pool (serve/paging.py, docs/paged-kv.md): exported only

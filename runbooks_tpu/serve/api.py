@@ -665,6 +665,19 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                       round(occ["occupancy_ratio"], 6),
                       help_text="Cached tokens / dense KV reservation "
                                 "(the paged-KV headroom signal).")
+        # KV pool HBM bytes, aggregate (logical) AND per-device: under a
+        # serving mesh (mesh_tensor > 1) the pool shards its kv-head
+        # axis, so each chip holds only pool/tensor bytes — the number
+        # capacity planning and OOM headroom actually see. Equal on a
+        # single device.
+        reg.set_gauge("serve_kv_pool_bytes", occ["kv_pool_bytes"],
+                      help_text="KV pool HBM bytes, aggregate across "
+                                "the serving mesh (logical size).")
+        reg.set_gauge("serve_kv_pool_bytes_per_device",
+                      occ["kv_pool_bytes_per_device"],
+                      help_text="KV pool HBM bytes each device holds "
+                                "(its shard under the serving mesh; "
+                                "equals the aggregate unsharded).")
         reg.set_counter("serve_prefix_lookups_total", eng.prefix_lookups,
                         help_text="Admissions that checked the shared-"
                                   "prefix cache.")

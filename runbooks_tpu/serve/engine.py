@@ -591,7 +591,7 @@ class InferenceEngine:
         self.last_token = np.zeros(max_slots, np.int32)
         self.slot_req: List[Optional[Request]] = [None] * max_slots
         self.queue: List[Request] = []
-        self.rng = jax.random.key(seed)
+        self.rng = self._commit_key(jax.random.key(seed))
         self.prefill_buckets = _buckets(self.max_seq_len)
         self.steps = 0
         # Shared-prefix KV cache: registered prompt prefixes (chat system
@@ -649,6 +649,21 @@ class InferenceEngine:
         engine (serve/paging.py) replaces the dense slot pool with a
         fixed page pool + allocator + radix tree here."""
         self.cache = self._new_pool_cache()
+
+    def _commit_key(self, key):
+        """Pin an rng key's placement under the serving mesh. A fresh key
+        traces as an UNSPECIFIED-sharding jit operand while the key a
+        dispatch RETURNS is committed (replicated NamedSharding) — two
+        cache entries for the same program, so every warmup-compiled
+        program would recompile once under steady traffic. Committing
+        the key up front makes warmup and runtime signatures identical.
+        No-op off-mesh (single-device placement is already unique)."""
+        if self.mesh is None:
+            return key
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            key, NamedSharding(self.mesh, PartitionSpec()))
 
     def _init_programs(self) -> None:
         """Build and register the engine's jitted program set. Overridable
@@ -809,7 +824,8 @@ class InferenceEngine:
                     args = (jnp.asarray(padded), jnp.asarray(positions),
                             jnp.zeros(r, jnp.int32),
                             jnp.ones(r, jnp.int32),
-                            jax.random.key(0), jnp.zeros(r, jnp.float32),
+                            self._commit_key(jax.random.key(0)),
+                            jnp.zeros(r, jnp.float32),
                             jnp.zeros(r, jnp.int32),
                             jnp.ones(r, jnp.float32))
                     akw = self._adapter_kwargs(np.full(r, -1, np.int32))
@@ -826,7 +842,7 @@ class InferenceEngine:
                 args = (jnp.asarray(zeros),
                         jnp.asarray(np.full(self.max_slots, self._pad_slot,
                                             np.int32)),
-                        jax.random.key(0),
+                        self._commit_key(jax.random.key(0)),
                         jnp.zeros(self.max_slots, jnp.float32),
                         jnp.zeros(self.max_slots, jnp.int32),
                         jnp.ones(self.max_slots, jnp.float32),
@@ -845,7 +861,8 @@ class InferenceEngine:
                                 np.int32)
                 for view in self.view_buckets:
                     args = (jnp.asarray(vtok), jnp.asarray(zeros),
-                            jnp.asarray(zeros), jax.random.key(0),
+                            jnp.asarray(zeros),
+                            self._commit_key(jax.random.key(0)),
                             jnp.zeros(self.max_slots, jnp.float32),
                             jnp.zeros(self.max_slots, jnp.int32),
                             jnp.ones(self.max_slots, jnp.float32),
@@ -1064,7 +1081,8 @@ class InferenceEngine:
                 self.params, buffers, pk, pv,
                 jnp.asarray(toks), jnp.asarray(positions),
                 jnp.zeros(rows, jnp.int32), jnp.zeros(rows, jnp.int32),
-                jax.random.key(0), jnp.zeros(rows, jnp.float32),
+                self._commit_key(jax.random.key(0)),
+                jnp.zeros(rows, jnp.float32),
                 jnp.zeros(rows, jnp.int32), jnp.ones(rows, jnp.float32),
                 **self._adapter_kwargs(np.full(rows, -1, np.int32)))
         return buffers
@@ -1143,10 +1161,19 @@ class InferenceEngine:
         (docs/observability.md)."""
         capacity = self.max_slots * self.max_seq_len
         tokens = int(self.lengths[self.active].sum()) if capacity else 0
+        # Aggregate vs per-device bytes: nbytes is the LOGICAL pool size;
+        # under a serving mesh each chip holds only its kv-head shard
+        # (shard_local_nbytes reads the sharding metadata, no sync).
+        arrays = [a for a in (self.cache.k, self.cache.v,
+                              self.cache.k_scale, self.cache.v_scale)
+                  if a is not None]
         return {"slots_total": self.max_slots,
                 "slots_active": int(self.active.sum()),
                 "kv_tokens": tokens,
                 "kv_capacity_tokens": capacity,
+                "kv_pool_bytes": sum(int(a.nbytes) for a in arrays),
+                "kv_pool_bytes_per_device":
+                    sum(obs_device.shard_local_nbytes(a) for a in arrays),
                 "occupancy_ratio": (tokens / capacity) if capacity else 0.0}
 
     def memory_groups(self) -> dict:

@@ -793,11 +793,27 @@ class PagedInferenceEngine(InferenceEngine):
     def __init__(self, cfg: ModelConfig, params: Params, *,
                  page_size: int = 16, num_pages: Optional[int] = None,
                  **kwargs):
-        if kwargs.get("mesh") is not None:
-            raise ValueError(
-                "paged KV serving does not support a serving mesh yet; "
-                "use the dense engine for sharded serving "
-                "(docs/paged-kv.md)")
+        mesh = kwargs.get("mesh")
+        if mesh is not None:
+            # Precise mesh-geometry validation: each error names the one
+            # constraint that failed (docs/troubleshooting.md). Anything
+            # that passes here serves correctly — the pool shards its
+            # kv-heads axis over `tensor` and replicates over the data/
+            # fsdp axes (page identity is global: the page tables, the
+            # allocator, and the radix tree stay replicated host state).
+            if not isinstance(mesh, jax.sharding.Mesh):
+                raise ValueError(
+                    f"mesh must be a jax.sharding.Mesh, got "
+                    f"{type(mesh).__name__}")
+            tensor = int(mesh.shape.get("tensor", 1))
+            if tensor > 1 and cfg.num_kv_heads % tensor:
+                raise ValueError(
+                    f"kv-heads not divisible by mesh_tensor: the paged "
+                    f"pool shards num_kv_heads={cfg.num_kv_heads} over "
+                    f"tensor={tensor}; pick mesh_tensor dividing the "
+                    f"kv-head count (docs/paged-kv.md)")
+            # stage > 1 is rejected by the dense engine's constructor
+            # (pipeline parallelism is a training-path feature).
         self.page_size = int(page_size)
         self._num_pages_arg = num_pages
         super().__init__(cfg, params, **kwargs)
@@ -823,8 +839,35 @@ class PagedInferenceEngine(InferenceEngine):
                 f"max-length sequence ({self.pages_per_slot} pages)")
         self.pager = PagedKVManager(self.num_pages, ps, self.max_slots,
                                     self.pages_per_slot)
-        self.cache = PagePool.create(self.cfg, self.num_pages, ps,
-                                     quantize_kv=self.quantize_kv)
+        self.cache = self._shard_pool(
+            PagePool.create(self.cfg, self.num_pages, ps,
+                            quantize_kv=self.quantize_kv))
+
+    def _shard_pool(self, pool: PagePool) -> PagePool:
+        """Lay the pool out under the serving mesh: kv-heads (axis 3 of
+        the 5-d k/v, axis 3 of the 4-d scales) shard over `tensor`;
+        every other axis replicates. The page axis must NOT shard — page
+        ids are global (one page table serves every shard), and the
+        jitted bodies' flat [L, (num_pages+1)*page_size, kvh, d] reshape
+        preserves the kv-head axis, so gathers/scatters index only the
+        replicated flat-token axis and GSPMD propagates the head
+        sharding straight through them."""
+        if self.mesh is None:
+            return pool
+        from jax.sharding import NamedSharding
+
+        from runbooks_tpu.parallel.sharding import spec_for_array
+
+        def put(a):
+            if a is None:
+                return None
+            logical = (None, None, None, "act_heads", None)[:a.ndim]
+            return jax.device_put(a, NamedSharding(
+                self.mesh, spec_for_array(a.shape, logical, self.mesh)))
+
+        return PagePool(k=put(pool.k), v=put(pool.v),
+                        k_scale=put(pool.k_scale),
+                        v_scale=put(pool.v_scale))
 
     def reset(self) -> None:
         """Crash recovery: donated pool buffers may be invalid, so the
@@ -832,9 +875,9 @@ class PagedInferenceEngine(InferenceEngine):
         pages lived in the doomed pool, so its content goes too."""
         self.pager = PagedKVManager(self.num_pages, self.page_size,
                                     self.max_slots, self.pages_per_slot)
-        self.cache = PagePool.create(self.cfg, self.num_pages,
-                                     self.page_size,
-                                     quantize_kv=self.quantize_kv)
+        self.cache = self._shard_pool(
+            PagePool.create(self.cfg, self.num_pages, self.page_size,
+                            quantize_kv=self.quantize_kv))
         self.lengths[:] = 0
         self.active[:] = False
         self.last_token[:] = 0
@@ -944,7 +987,7 @@ class PagedInferenceEngine(InferenceEngine):
                                    np.int32)
                     args = (jnp.asarray(tokens), jnp.asarray(positions),
                             jnp.asarray(dest), jnp.zeros(r, jnp.int32),
-                            jax.random.key(0),
+                            self._commit_key(jax.random.key(0)),
                             jnp.zeros(r, jnp.float32),
                             jnp.zeros(r, jnp.int32),
                             jnp.ones(r, jnp.float32))
@@ -953,11 +996,13 @@ class PagedInferenceEngine(InferenceEngine):
                             jnp.full((r, ppb), trash, jnp.int32),
                             jnp.zeros(r, jnp.int32))
                     akw = self._adapter_kwargs(np.full(r, -1, np.int32))
-                    record_cost("paged_prefill", f"b{bucket}r{r}p{ppb}",
-                                self._paged_prefill, self.params,
-                                self.cache, *args, **akw)
-                    _, self.cache, _ = self._paged_prefill(
-                        self.params, self.cache, *args, **akw)
+                    with self._mesh_ctx():
+                        record_cost("paged_prefill",
+                                    f"b{bucket}r{r}p{ppb}",
+                                    self._paged_prefill, self.params,
+                                    self.cache, *args, **akw)
+                        _, self.cache, _ = self._paged_prefill(
+                            self.params, self.cache, *args, **akw)
                     n_prefill += 1
             zeros = np.zeros(self.max_slots, np.int32)
             tables = np.full((self.max_slots, self.pages_per_slot), trash,
@@ -965,18 +1010,20 @@ class PagedInferenceEngine(InferenceEngine):
             akw = self._adapter_kwargs()
             for vp in self.view_page_buckets:
                 args = (jnp.asarray(tables), jnp.asarray(zeros),
-                        jnp.asarray(zeros), jax.random.key(0),
+                        jnp.asarray(zeros),
+                        self._commit_key(jax.random.key(0)),
                         jnp.zeros(self.max_slots, jnp.float32),
                         jnp.zeros(self.max_slots, jnp.int32),
                         jnp.ones(self.max_slots, jnp.float32),
                         jnp.full(self.max_slots, -1, jnp.int32),
                         jnp.zeros(self.max_slots, jnp.int32),
                         jnp.zeros(self.max_slots, bool))
-                record_cost(f"decode_p{vp}", f"p{vp}",
-                            self._decode_for(vp), self.params,
-                            self.cache, *args, **akw)
-                _, _, self.cache, _ = self._decode_for(vp)(
-                    self.params, self.cache, *args, **akw)
+                with self._mesh_ctx():
+                    record_cost(f"decode_p{vp}", f"p{vp}",
+                                self._decode_for(vp), self.params,
+                                self.cache, *args, **akw)
+                    _, _, self.cache, _ = self._decode_for(vp)(
+                        self.params, self.cache, *args, **akw)
             n_verify = 0
             if self.speculative != "off":
                 vtok = np.zeros((self.max_slots, self.draft_tokens + 1),
@@ -984,16 +1031,17 @@ class PagedInferenceEngine(InferenceEngine):
                 for vp in self.view_page_buckets:
                     args = (jnp.asarray(tables), jnp.asarray(vtok),
                             jnp.asarray(zeros), jnp.asarray(zeros),
-                            jax.random.key(0),
+                            self._commit_key(jax.random.key(0)),
                             jnp.zeros(self.max_slots, jnp.float32),
                             jnp.zeros(self.max_slots, jnp.int32),
                             jnp.ones(self.max_slots, jnp.float32),
                             jnp.zeros(self.max_slots, bool))
-                    record_cost(f"verify_p{vp}", f"p{vp}",
-                                self._verify_for(vp), self.params,
-                                self.cache, *args, **akw)
-                    _, _, _, self.cache, _ = self._verify_for(vp)(
-                        self.params, self.cache, *args, **akw)
+                    with self._mesh_ctx():
+                        record_cost(f"verify_p{vp}", f"p{vp}",
+                                    self._verify_for(vp), self.params,
+                                    self.cache, *args, **akw)
+                        _, _, _, self.cache, _ = self._verify_for(vp)(
+                            self.params, self.cache, *args, **akw)
                     n_verify += 1
         census = obs_device.PROGRAMS.census("serve")
         self.warmup_census = {
@@ -1339,7 +1387,16 @@ class PagedInferenceEngine(InferenceEngine):
         tokens = (int(self.lengths[self.active].sum())
                   if self.active.any() else 0)
         capacity = self.num_pages * ps
-        bpp = self.cache.nbytes // (self.num_pages + 1)
+        # nbytes is LOGICAL (global) bytes; under a serving mesh each
+        # chip holds only its kv-head shard of the pool, so both views
+        # are reported — per-device is what admission headroom and OOMs
+        # actually see (docs/observability.md).
+        pool_bytes = self.cache.nbytes
+        arrays = [a for a in (self.cache.k, self.cache.v,
+                              self.cache.k_scale, self.cache.v_scale)
+                  if a is not None]
+        pool_local = sum(obs_device.shard_local_nbytes(a) for a in arrays)
+        bpp = pool_bytes // (self.num_pages + 1)
         return {"slots_total": self.max_slots,
                 "slots_active": int(self.active.sum()),
                 "kv_tokens": tokens,
@@ -1349,6 +1406,10 @@ class PagedInferenceEngine(InferenceEngine):
                 "paged": True,
                 "page_size": ps,
                 "bytes_per_page": bpp,
+                "kv_pool_bytes": pool_bytes,
+                "kv_pool_bytes_per_device": pool_local,
+                "bytes_per_page_per_device":
+                    pool_local // (self.num_pages + 1),
                 "kv_bytes_shared": occ["pages_shared"] * bpp,
                 "kv_bytes_private":
                     (occ["pages_used"] - occ["pages_shared"]) * bpp,
