@@ -173,6 +173,16 @@ run serve-lora env RBT_BENCH_LORA=1 python bench_serve.py
 run serve-mesh env RBT_BENCH_MESH_SERVE=1 RBT_BENCH_MESH_TENSOR=2 \
   python bench_serve.py
 
+# 4a7. Host KV tier + QoS preemption (docs/paged-kv.md "Host tier and
+#      preemption"): returning-session TTFT with the prefix host-
+#      resident (swap-in) vs fully dropped (recompute), token outputs
+#      asserted identical, then an overload phase where batch slots
+#      preempt for interactive arrivals and resume loss-free
+#      (acceptance: swap-in >= 1.1x faster, vs_baseline = speedup/1.1,
+#      forced to 0 on any unexpected compile, token divergence, or an
+#      overload run that never preempted).
+run serve-kv-tier env RBT_BENCH_KV_TIER=1 python bench_serve.py
+
 # 4b. Observability instrumentation overhead (docs/observability.md):
 #     the per-step cost of the obs subsystem (spans + histogram observes +
 #     goodput update) as a percent of the real step time, PLUS the fleet-

@@ -303,6 +303,8 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
     # enumeration helpers warmup walks.
     from runbooks_tpu.serve.paging import (
         PagePool,
+        make_kv_swap_in_fn,
+        make_kv_swap_out_fn,
         make_paged_decode_fn,
         make_paged_prefill_fn,
         make_paged_verify_fn,
@@ -347,6 +349,18 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         _sds((slots,), jnp.int32), _sds((slots,), jnp.int32), key,
         _sds((slots,), jnp.float32), _sds((slots,), jnp.int32),
         _sds((slots,), jnp.float32), _sds((slots,), jnp.bool_)]
+
+    # Host-tier swap splices (docs/paged-kv.md "Host tier and
+    # preemption"): the page index is a traced operand, so each
+    # direction is ONE program for every page — signature cardinality 1.
+    # The swap-in payload operands mirror the host buffers (one page's
+    # K/V, pool dtype, numpy-backed at runtime).
+    kv_swap_out = make_kv_swap_out_fn()
+    kv_swap_in = make_kv_swap_in_fn()
+    page_shape = (paged_pool.k.shape[0],) + paged_pool.k.shape[2:]
+    kv_swap_in_args = [paged_pool, _sds((), jnp.int32),
+                       _sds(page_shape, paged_pool.k.dtype),
+                       _sds(page_shape, paged_pool.v.dtype)]
 
     # Multi-tenant LoRA adapter variants (docs/multi-tenant-lora.md): a
     # pooled engine jits THESE shapes instead of the plain set — same
@@ -412,6 +426,10 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         {"component": "serve", "name": "paged_verify",
          "fn": paged_verify, "args": paged_verify_args,
          "signatures": len(vp_buckets)},
+        {"component": "serve", "name": "kv_swap_out", "fn": kv_swap_out,
+         "args": [paged_pool, _sds((), jnp.int32)], "signatures": 1},
+        {"component": "serve", "name": "kv_swap_in", "fn": kv_swap_in,
+         "args": kv_swap_in_args, "signatures": 1},
         {"component": "serve", "name": "adapter_prefill",
          "fn": adapter_prefill,
          "args": ([params, pool, apool, aslots_sds(rows_set[-1])]

@@ -81,6 +81,11 @@ ENUM_PARAMS = {
     # value would otherwise silently serve the dense slot pool.
     **{k: ("off", "paged") for k in ("kv_paging", "kvPaging",
                                      "kvpaging")},
+    # QoS slot preemption over the host KV tier (serve/paging.py,
+    # docs/paged-kv.md "Host tier and preemption"): a typo'd value
+    # would otherwise silently serve with overload-429 as the only
+    # degradation mode. One spelling — the name has no word boundary.
+    "preemption": ("off", "swap"),
     # Speculative decoding (serve/engine.py verify path,
     # docs/speculative-decoding.md): a typo'd value would otherwise
     # silently serve without drafting.
@@ -126,6 +131,18 @@ _ADAPTER_POOL_KEYS = ("adapter_pool", "adapterPool", "adapterpool")
 _LORA_RANK_KEYS = ("lora_rank", "loraRank", "lorarank")
 _ADAPTER_DIR_KEYS = ("adapter_dir", "adapterDir", "adapterdir")
 
+# Host-RAM KV swap tier + per-class queue shares (serve/paging.py,
+# docs/paged-kv.md "Host tier and preemption"). kv_host_pages sizes the
+# pinned host pool (0 = no host tier); queue_share_<class> bounds each
+# QoS class to a fraction of max_queue. Same three-spelling convention
+# as the other serving knobs.
+_KV_HOST_PAGES_KEYS = ("kv_host_pages", "kvHostPages", "kvhostpages")
+_QOS_CLASSES = ("interactive", "standard", "batch")
+_QUEUE_SHARE_KEYS = tuple(
+    k for c in _QOS_CLASSES
+    for k in (f"queue_share_{c}", f"queueShare{c.capitalize()}",
+              f"queueshare{c}"))
+
 # Mesh geometry axes (parallel/mesh.py MESH_AXES — keep in sync like
 # DEFAULT_NGRAM_MAX; not imported so the controller stays jax-free). A
 # spec selects sharded serving/training with mesh_<axis> integer params;
@@ -161,6 +178,8 @@ INT_PARAMS = {
     # is valid (off); the rank bucket must hold at least one column.
     **{k: 0 for k in _ADAPTER_POOL_KEYS},
     **{k: 1 for k in _LORA_RANK_KEYS},
+    # Host KV tier size: 0 is valid (no host tier — evictions drop).
+    **{k: 0 for k in _KV_HOST_PAGES_KEYS},
 }
 
 # Float-valued params the workloads float()-coerce at startup: key ->
@@ -355,6 +374,33 @@ def validate_params(params: dict) -> Optional[str]:
                 "the pool serves per-request adapters; point tenant "
                 "Servers at this pool via spec.engineRef instead "
                 "(docs/multi-tenant-lora.md)")
+    # Host KV tier / QoS cross-field checks (docs/paged-kv.md "Host
+    # tier and preemption"): the host tier and swap preemption only
+    # exist on the paged engine — without kv_paging: paged the replica
+    # would crash-loop at engine construction instead of surfacing a
+    # condition. Queue shares are fractions of max_queue in (0, 1].
+    for key in _QUEUE_SHARE_KEYS:
+        val = params.get(key)
+        if val is None:
+            continue
+        try:
+            share = float(val)
+        except (TypeError, ValueError):
+            return f"spec.params.{key}: {val!r} is not a number"
+        if not 0.0 < share <= 1.0:
+            return f"spec.params.{key}: {val} must be in (0, 1]"
+    paging = next((params[k] for k in ("kv_paging", "kvPaging",
+                                       "kvpaging")
+                   if params.get(k) is not None), "off")
+    host_pages = next((params[k] for k in _KV_HOST_PAGES_KEYS
+                       if params.get(k) is not None), 0)
+    if int(host_pages or 0) > 0 and str(paging) != "paged":
+        return ("spec.params.kv_host_pages: the host KV tier swaps "
+                "radix PAGES; set kv_paging: paged (docs/paged-kv.md)")
+    if str(params.get("preemption") or "off") == "swap" \
+            and str(paging) != "paged":
+        return ("spec.params.preemption: swap preempts at page "
+                "granularity; set kv_paging: paged (docs/paged-kv.md)")
     # Mesh geometry (parallel/mesh.py): mesh_<axis> params select a
     # sharded engine. An unknown axis name is a typo the workload would
     # silently ignore (serving a single chip while the spec says eight);

@@ -133,6 +133,18 @@ CATALOG: Dict[str, str] = {
     "serve_kv_pages_used": "gauge",
     "serve_kv_pages_shared": "gauge",
     "serve_prefix_pages_reused_total": "counter",
+    # Host-RAM KV swap tier + QoS preemption (serve/paging.py,
+    # docs/paged-kv.md "Host tier and preemption"): swap families are
+    # exported only when kv_host_pages > 0; the preemption counters are
+    # unconditional (0 on engines without preemption)
+    "serve_kv_host_pages_used": "gauge",
+    "serve_kv_host_pages_free": "gauge",
+    "serve_kv_swap_out_pages_total": "counter",
+    "serve_kv_swap_in_pages_total": "counter",
+    "serve_kv_swap_dropped_pages_total": "counter",
+    "serve_kv_swap_seconds": "histogram",
+    "serve_preemptions_total": "counter",
+    "serve_preempted_resumed_total": "counter",
     # Multi-tenant LoRA adapter pool (serve/lora_pool.py,
     # docs/multi-tenant-lora.md): exported only by pooled engines
     "serve_adapter_loads_total": "counter",
@@ -147,6 +159,7 @@ CATALOG: Dict[str, str] = {
     "gateway_retries_total": "counter",
     "gateway_affinity_requests_total": "counter",
     "gateway_affinity_hits_total": "counter",
+    "gateway_shed_passthrough_total": "counter",
     "gateway_proxy_latency_seconds": "histogram",
     "gateway_replicas_healthy": "gauge",
     "gateway_shadow_blocks": "gauge",

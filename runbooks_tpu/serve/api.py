@@ -33,6 +33,7 @@ from runbooks_tpu.obs import incident as obs_incident
 # back-compat with existing importers.
 from runbooks_tpu.obs.trace import request_scope  # noqa: F401
 from runbooks_tpu.serve.engine import (
+    PRIORITY_RANK,
     EngineDraining,
     EngineOverloaded,
     EngineStepFailed,
@@ -503,7 +504,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   ngram_min: Optional[int] = None,
                   adapter_pool: Optional[int] = None,
                   lora_rank: Optional[int] = None,
-                  adapter_dir: Optional[str] = None) -> web.Application:
+                  adapter_dir: Optional[str] = None,
+                  kv_host_pages: int = 0,
+                  preemption: str = "off",
+                  queue_shares: Optional[dict] = None) -> web.Application:
     """max_queue bounds the admission queue (full -> HTTP 429 with
     Retry-After); request_timeout_s is the default per-request wall-clock
     deadline (body field "timeout" overrides per request; expiry finishes
@@ -530,7 +534,17 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     docs/multi-tenant-lora.md): per-request `adapter` names pin HBM
     pool lanes at admission and heterogeneous tenants batch in one
     dispatch. lora_rank is the static rank bucket; adapter_dir roots
-    relative adapter names (absolute paths pass through)."""
+    relative adapter names (absolute paths pass through).
+
+    kv_host_pages >= 1 (paged engines only) adds the host-RAM KV swap
+    tier (docs/paged-kv.md): LRU-evicted radix pages copy to pinned
+    host buffers instead of dropping, and returning sessions swap back
+    in at device_put cost instead of re-prefilling. preemption="swap"
+    lets the engine preempt the lowest-priority active slot under
+    pressure (pages swap to host, the request re-queues with generated
+    tokens intact). queue_shares maps priority class -> fraction of
+    max_queue that class may occupy (admission 429s a class past its
+    share while others still fit)."""
     if not request_timeout_s:
         # 0 disables, like the other *_s knobs — a validated config of 0
         # must mean "no deadline", not "400 every deadline-less request".
@@ -548,7 +562,9 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             speculative=speculative, draft_tokens=draft_tokens,
             ngram_max=ngram_max, ngram_min=ngram_min,
             adapter_pool=adapter_pool, lora_rank=lora_rank,
-            adapter_dir=adapter_dir)
+            adapter_dir=adapter_dir,
+            kv_host_pages=kv_host_pages, preemption=preemption,
+            queue_shares=queue_shares)
     else:
         engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
                                  max_seq_len=max_seq_len, mesh=mesh,
@@ -562,7 +578,9 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                                  ngram_min=ngram_min,
                                  adapter_pool=adapter_pool,
                                  lora_rank=lora_rank,
-                                 adapter_dir=adapter_dir)
+                                 adapter_dir=adapter_dir,
+                                 preemption=preemption,
+                                 queue_shares=queue_shares)
     if warmup:
         # Pre-compile all buckets before readiness flips. warm_prefix
         # (params.json: warm_prefix) additionally compiles the prefix-KV
@@ -594,9 +612,14 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             return web.json_response(
                 {"error": {"message": str(exc), "type": "draining"}},
                 status=503, headers={"Retry-After": "5"})
+        # Load-derived backoff: queue depth in slot-drain units, clamped
+        # to [1, 30] (engine.retry_after_hint) — a deep backlog tells
+        # clients (and the gateway's per-class retry budget) how long
+        # this replica actually needs, instead of a constant "1".
         return web.json_response(
             {"error": {"message": str(exc), "type": "overloaded"}},
-            status=429, headers={"Retry-After": "1"})
+            status=429,
+            headers={"Retry-After": str(worker.engine.retry_after_hint())})
 
     async def root(request: web.Request) -> web.Response:
         # Readiness probe target (reference probes GET / on the serve port).
@@ -635,6 +658,14 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         reg.set_counter("serve_requests_rejected_total",
                         app["requests_rejected_total"],
                         help_text="Requests shed with 429/503.")
+        reg.set_counter("serve_preemptions_total", eng.preemptions,
+                        help_text="Active slots preempted for a higher-"
+                                  "priority queue head (pages swapped to "
+                                  "the radix tree / host tier).")
+        reg.set_counter("serve_preempted_resumed_total",
+                        eng.preempted_resumed,
+                        help_text="Preempted requests re-admitted and "
+                                  "resumed from their cached history.")
         reg.set_counter("serve_deadline_expired_total", eng.deadline_expired,
                         help_text="Requests finished by wall-clock "
                                   "deadline.")
@@ -745,6 +776,35 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                                       "radix tree into admissions instead "
                                       "of being re-prefilled (counted per "
                                       "page, not per admission).")
+            if occ.get("host_pages_total"):
+                # Host-RAM KV swap tier (docs/paged-kv.md "Host tier and
+                # preemption"): swap traffic + host-pool pressure.
+                # Exported only when kv_host_pages > 0, like the paged
+                # families above.
+                reg.set_gauge("serve_kv_host_pages_used",
+                              occ["host_pages_used"],
+                              help_text="Host-tier page slots holding "
+                                        "swapped-out KV pages.")
+                reg.set_gauge("serve_kv_host_pages_free",
+                              occ["host_pages_free"],
+                              help_text="Host-tier page slots on the "
+                                        "free list.")
+                reg.set_counter("serve_kv_swap_out_pages_total",
+                                occ["swap_out_pages_total"],
+                                help_text="KV pages copied HBM -> host "
+                                          "at radix eviction instead of "
+                                          "being dropped.")
+                reg.set_counter("serve_kv_swap_in_pages_total",
+                                occ["swap_in_pages_total"],
+                                help_text="KV pages copied host -> HBM "
+                                          "at admission (radix match on "
+                                          "the host tier).")
+                reg.set_counter("serve_kv_swap_dropped_pages_total",
+                                occ["swap_dropped_pages_total"],
+                                help_text="Evicted pages dropped because "
+                                          "the host tier was full or the "
+                                          "copy failed (recompute on "
+                                          "return).")
         obs_device.set_memory_gauges(reg)
         obs_device.PROGRAMS.set_gauges(reg, component="serve")
         # Flight recorder + incident freshness (docs/observability.md):
@@ -947,8 +1007,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 {"error": {"message": "invalid JSON body"}}, status=400)
         return await _complete(request.app, body, http_request=request)
 
-    def _parse_requests(app_, body):
-        """Shared validation: body -> list[Request] or an error Response."""
+    def _parse_requests(app_, body, default_priority=None):
+        """Shared validation: body -> list[Request] or an error Response.
+        default_priority is the X-Priority header value (the body field
+        `priority` wins when both are set); None/absent -> standard."""
         prompt = body.get("prompt")
         if prompt is None:
             return None, web.json_response(
@@ -990,6 +1052,18 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             return None, web.json_response(
                 {"error": {"message": "adapter must be a string"}},
                 status=400)
+        # QoS class (docs/paged-kv.md "Host tier and preemption"): body
+        # field beats the X-Priority header beats the standard default.
+        priority = body.get("priority")
+        if priority is None:
+            priority = default_priority or "standard"
+        if (not isinstance(priority, str)
+                or priority.lower() not in PRIORITY_RANK):
+            return None, web.json_response(
+                {"error": {"message": "priority must be one of "
+                                      "interactive, standard, batch"}},
+                status=400)
+        priority = priority.lower()
 
         tok = app_["tokenizer"]
         eos = _eos_id(tok)
@@ -998,7 +1072,8 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             reqs.append(Request(
                 prompt_tokens=_encode(tok, p), max_tokens=max_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos, deadline_s=deadline, adapter=adapter))
+                eos_id=eos, deadline_s=deadline, adapter=adapter,
+                priority=priority))
         return reqs, None
 
     async def _stream(app_, body, reqs, http_request, chat: bool = False,
@@ -1148,7 +1223,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
 
     async def _complete_scoped(app_, body, http_request, rid,
                                tp_out) -> web.Response:
-        reqs, err = _parse_requests(app_, body)
+        hdr_priority = (http_request.headers.get("X-Priority")
+                        if http_request is not None else None)
+        reqs, err = _parse_requests(app_, body,
+                                    default_priority=hdr_priority)
         if err is not None:
             return err
         # Thread the id through admission -> engine slot -> prefill/
@@ -1395,6 +1473,18 @@ def main() -> int:
                            "drafttokens")
     ngram_max_raw = _param_any(params, "ngram_max", "ngramMax", "ngrammax")
     ngram_min_raw = _param_any(params, "ngram_min", "ngramMin", "ngrammin")
+    host_pages_raw = _param_any(params, "kv_host_pages", "kvHostPages",
+                                "kvhostpages")
+    preemption_raw = params.get("preemption")
+    # Per-class queue shares (queue_share_interactive: 0.5 etc.) fold
+    # into the queue_shares dict the engine validates.
+    queue_shares = {}
+    for cls in ("interactive", "standard", "batch"):
+        camel = f"queueShare{cls.capitalize()}"
+        raw = _param_any(params, f"queue_share_{cls}", camel,
+                         camel.lower())
+        if raw is not None:
+            queue_shares[cls] = float(raw)
     app = create_server(
         cfg, model_params, tokenizer,
         max_slots=int(params.get("max_slots", 8)),
@@ -1441,7 +1531,16 @@ def main() -> int:
         # (A pool-less `adapter: <path>` already folded at load_model.)
         adapter_pool=int(pool_raw) if pool_raw is not None else None,
         lora_rank=int(rank_raw) if rank_raw is not None else None,
-        adapter_dir=str(adapter_dir_raw) if adapter_dir_raw else None)
+        adapter_dir=str(adapter_dir_raw) if adapter_dir_raw else None,
+        # Host-RAM KV swap tier + QoS preemption (docs/paged-kv.md):
+        # `preemption: swap` is the validated spelling (controller
+        # validate_params); the engine re-validates both before any
+        # cache allocation.
+        kv_host_pages=(int(host_pages_raw)
+                       if host_pages_raw is not None else 0),
+        preemption=(str(preemption_raw)
+                    if preemption_raw is not None else "off"),
+        queue_shares=queue_shares or None)
     port = int(params.get("port", contract.SERVE_PORT))
 
     # Graceful drain on SIGTERM (docs/fault-tolerance.md): run_app's

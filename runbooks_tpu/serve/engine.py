@@ -93,6 +93,16 @@ def _observe_request_done(req: "Request", now: float) -> None:
                            req.finish_reason or "stop")
 
 
+# QoS classes, best first. Admission orders the queue by class (FIFO
+# within a class) and — on the paged engine with preemption enabled —
+# a blocked higher-class head preempts the worst-class active slot
+# (docs/paged-kv.md "Host tier and preemption"). The gateway forwards
+# the class as X-Priority and spills batch traffic first
+# (serve/gateway.py); the strings are the public API surface
+# (docs/api.md `priority`).
+PRIORITY_RANK = {"interactive": 0, "standard": 1, "batch": 2}
+
+
 class EngineOverloaded(RuntimeError):
     """Typed admission rejection: the bounded queue is full. Backpressure
     instead of unbounded queue growth — serve/api.py maps this to HTTP 429
@@ -144,6 +154,10 @@ class Request:
     # adapter's pool lane (paging it into HBM if needed) and the slot
     # carries the lane index into every batched dispatch.
     adapter: Optional[str] = None
+    # QoS class (PRIORITY_RANK): orders the admission queue and selects
+    # preemption victims under page/slot pressure — batch work yields
+    # to interactive work instead of degrading every tenant equally.
+    priority: str = "standard"
     # Filled by the engine:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
@@ -154,6 +168,12 @@ class Request:
     on_token: Optional[Callable[[int], None]] = None
     _slot: int = -1
     _adapter_lane: int = -1   # pool lane pinned at admission (-1 = base)
+    # Preempted and re-queued (paged engine, preemption="swap"): the
+    # request's generated-so-far tokens stay in output_tokens and its
+    # written pages live on in the radix tree (HBM or host tier), so
+    # re-admission resumes via a radix match on its own history — no
+    # token loss, no resample of already-recorded tokens.
+    _preempted: bool = False
     _submitted: float = 0.0   # monotonic submit time (deadline anchor)
     _admitted: float = 0.0    # monotonic admission time (queue-wait end)
     _last_token_t: float = 0.0  # previous token's host-observed time
@@ -395,6 +415,11 @@ class InferenceEngine:
     """Batched generation over a fixed slot pool. Thread-unsafe by design;
     drive it from one loop (the API server wraps it in a single worker)."""
 
+    # Preemption swaps a victim's pages into the radix tree — only the
+    # paged engine has pages, so the dense constructor rejects
+    # preemption="swap" (serve/paging.py flips this).
+    _supports_preemption = False
+
     def __init__(self, cfg: ModelConfig, params: Params, *,
                  max_slots: int = 8, max_seq_len: Optional[int] = None,
                  seed: int = 0, mesh=None,
@@ -409,7 +434,9 @@ class InferenceEngine:
                  ngram_min: Optional[int] = None,
                  adapter_pool: Optional[int] = None,
                  lora_rank: Optional[int] = None,
-                 adapter_dir: Optional[str] = None):
+                 adapter_dir: Optional[str] = None,
+                 preemption: str = "off",
+                 queue_shares: Optional[dict] = None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
@@ -472,7 +499,21 @@ class InferenceEngine:
         lanes) and base-only rows ride the all-zero trash lane, so
         mixed-tenant traffic batches in ONE dispatch. lora_rank is the
         static rank bucket every lane pads to; adapter_dir roots
-        relative adapter names."""
+        relative adapter names.
+
+        preemption: "off" (default) or "swap" (paged engine only).
+        With "swap", a queue head blocked on pages/slots preempts the
+        lowest-class active slot at a step boundary: the victim's
+        written pages are adopted into the radix tree (where they may
+        later swap to the host tier), the request re-queues with its
+        generated tokens intact, and it resumes via a radix match on
+        its own history (docs/paged-kv.md).
+
+        queue_shares: optional {class: share} dict bounding each QoS
+        class to ceil(share * max_queue) queued entries (share in
+        (0, 1], default 1.0 per class) — a batch flood then sheds with
+        429 before it can fill the whole queue against interactive
+        traffic."""
         self.cfg = cfg
         self.mesh = mesh
         self.prefill_budget = prefill_budget
@@ -585,7 +626,35 @@ class InferenceEngine:
             self.prefill_budget = self.max_seq_len
         self.max_queue = (max_queue if max_queue is not None
                           else max(16, 4 * max_slots))
+        if preemption not in ("off", "swap"):
+            raise ValueError(
+                f"preemption must be 'off' or 'swap', got {preemption!r}")
+        if preemption == "swap" and not self._supports_preemption:
+            raise ValueError(
+                "preemption: swap needs the paged engine (pages are the "
+                "unit a preempted slot swaps at); set kv_paging: paged "
+                "(docs/paged-kv.md)")
+        self.preemption = preemption
+        # Per-class queued-entry bounds from queue_shares; missing
+        # classes default to the full queue.
+        shares = dict(queue_shares or {})
+        for cls, share in shares.items():
+            if cls not in PRIORITY_RANK:
+                raise ValueError(
+                    f"queue_shares: unknown class {cls!r} (expected one "
+                    f"of {sorted(PRIORITY_RANK)})")
+            if not 0.0 < float(share) <= 1.0:
+                raise ValueError(
+                    f"queue_shares[{cls!r}] must be in (0, 1], got "
+                    f"{share}")
+        self.queue_shares = {
+            cls: float(shares.get(cls, 1.0)) for cls in PRIORITY_RANK}
+        self._class_bounds = {
+            cls: max(1, int(np.ceil(self.max_queue * s)))
+            for cls, s in self.queue_shares.items()}
         self.deadline_expired = 0   # observability/tests
+        self.preemptions = 0          # slots preempted (observability)
+        self.preempted_resumed = 0    # preempted requests re-admitted
         self.lengths = np.zeros(max_slots, np.int32)       # tokens in cache
         self.active = np.zeros(max_slots, bool)
         self.last_token = np.zeros(max_slots, np.int32)
@@ -634,6 +703,12 @@ class InferenceEngine:
         # Parsed once here, not per step: the hot loop must not pay an
         # env read per chunk.
         self._fault_step: Optional[int] = None
+        # RBT_FAULT_INJECT=swapfail:K — the Kth host-tier swap copy
+        # (swap-out or swap-in, shared count) fails; the engine must
+        # degrade to drop/recompute without crashing or leaking pages
+        # (docs/fault-tolerance.md). Parsed once, same discipline as
+        # engine:K.
+        self._swap_fault: Optional[int] = None
         fault = os.environ.get("RBT_FAULT_INJECT", "")
         if fault.startswith("engine:"):
             try:
@@ -642,6 +717,16 @@ class InferenceEngine:
                 raise ValueError(
                     f"RBT_FAULT_INJECT={fault!r}: expected engine:K") \
                     from exc
+        elif fault.startswith("swapfail:"):
+            try:
+                self._swap_fault = int(fault.split(":", 1)[1])
+            except ValueError as exc:
+                raise ValueError(
+                    f"RBT_FAULT_INJECT={fault!r}: expected swapfail:K") \
+                    from exc
+            if self._swap_fault < 1:
+                raise ValueError(
+                    f"RBT_FAULT_INJECT={fault!r}: K must be >= 1")
         self._init_programs()
 
     def _init_cache(self) -> None:
@@ -1105,6 +1190,10 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt of {len(req.prompt_tokens)} tokens exceeds the "
                 f"engine's context window ({self.max_seq_len})")
+        if req.priority not in PRIORITY_RANK:
+            raise ValueError(
+                f"priority must be one of {sorted(PRIORITY_RANK)}, got "
+                f"{req.priority!r}")
         if req.adapter is not None:
             if self.adapters is None:
                 raise ValueError(
@@ -1122,10 +1211,41 @@ class InferenceEngine:
             raise EngineOverloaded(
                 f"admission queue full ({len(self.queue)} waiting, "
                 f"bound {self.max_queue}); retry later")
+        bound = self._class_bounds[req.priority]
+        queued = sum(1 for q in self.queue if q.priority == req.priority)
+        if queued >= bound:
+            # Per-class share exhausted: this class sheds while the
+            # others keep their queue room — a batch flood cannot fill
+            # the whole queue against interactive traffic.
+            raise EngineOverloaded(
+                f"{req.priority} queue share full ({queued} waiting, "
+                f"class bound {bound} of {self.max_queue}); retry later")
         if req.adapter is not None and self.adapters is not None:
             self.adapters.count_request(req.adapter)
         req._submitted = time.monotonic()
-        self.queue.append(req)
+        self._queue_insert(req)
+
+    def _queue_insert(self, req: Request) -> None:
+        """Class-ordered insert: behind every queued request of the same
+        or better class, ahead of strictly worse ones — FIFO within a
+        class, interactive ahead of standard ahead of batch."""
+        rank = PRIORITY_RANK[req.priority]
+        idx = len(self.queue)
+        for i, q in enumerate(self.queue):
+            if PRIORITY_RANK[q.priority] > rank:
+                idx = i
+                break
+        self.queue.insert(idx, req)
+
+    def retry_after_hint(self) -> int:
+        """Load-derived Retry-After seconds for a shed request: the
+        queue depth in units of slot drains (each slot that frees
+        admits one queued request), clamped to [1, 30] so a deep
+        backlog never tells clients to hammer at 1 s or vanish for
+        minutes (docs/fault-tolerance.md)."""
+        backlog = len(self.queue)
+        hint = -(-backlog // max(self.max_slots, 1))
+        return int(min(max(hint, 1), 30))
 
     def reset(self) -> None:
         """Recover from a failed jitted step: donated cache buffers may be
@@ -1198,6 +1318,31 @@ class InferenceEngine:
     def _free_slots(self, exclude=()) -> List[int]:
         return [i for i in range(self.max_slots)
                 if not self.active[i] and i not in exclude]
+
+    @staticmethod
+    def _admit_tokens(req: Request) -> List[int]:
+        """The token history admission plans against. For a fresh
+        request that is the prompt; for a preempted one it is the prompt
+        plus every generated token already WRITTEN to the cache — all
+        outputs except the last (the carry token lives in last_token,
+        not the cache; see _activate_slot's resume branch). Planning
+        against this lets the radix match re-cover the request's own
+        adopted pages, so resume costs a device_put instead of a full
+        re-prefill."""
+        if req._preempted and req.output_tokens:
+            return req.prompt_tokens + req.output_tokens[:-1]
+        return req.prompt_tokens
+
+    @staticmethod
+    def _admit_budget(req: Request) -> int:
+        """Token budget past _admit_tokens for page reservation. For a
+        resumed request the generated-so-far tokens moved into the
+        effective prompt, so the budget shrinks by the same amount (+1
+        for the carry token) — the total reserve stays exactly the
+        original prompt + max_tokens, never over-reserving on resume."""
+        if req._preempted and req.output_tokens:
+            return req.max_tokens - len(req.output_tokens) + 1
+        return req.max_tokens
 
     def _bucket_for(self, n: int) -> int:
         return bucket_for(self.prefill_buckets, n)
@@ -1385,12 +1530,33 @@ class InferenceEngine:
         bookkeeping, the speculative draft index's context start, and
         the first token's recording (which may immediately finish a
         max_tokens=1 request)."""
+        resumed = bool(req._preempted and req.output_tokens)
+        eff = self._admit_tokens(req)
         self.active[slot] = True
-        self.lengths[slot] = len(req.prompt_tokens)
-        self.last_token[slot] = first_tok
+        self.lengths[slot] = len(eff)
         self.slot_req[slot] = req
         self.adapter_slots[slot] = req._adapter_lane
         req._slot = slot
+        if resumed:
+            # Resume after preemption: the cache again holds the full
+            # written history (prompt + outputs[:-1]), re-established by
+            # radix match on the HBM/host hierarchy plus a suffix
+            # prefill of whatever fell off page boundaries. The carry
+            # token — sampled before preemption, streamed to the
+            # client, never written — goes back into last_token so the
+            # next decode writes it at position lengths[slot]. The
+            # prefill's freshly sampled token is DISCARDED: that
+            # position's token was already recorded, and resampling it
+            # (different rng state) would fork the sequence.
+            carry = int(req.output_tokens[-1])
+            self.last_token[slot] = carry
+            if self._spec_index is not None:
+                self._spec_index.begin(slot, eff)
+                self._spec_index.extend(slot, carry)
+            req._preempted = False
+            self.preempted_resumed += 1
+            return
+        self.last_token[slot] = first_tok
         if self._spec_index is not None:
             self._spec_index.begin(slot, req.prompt_tokens)
         self._record_token(slot, first_tok)
@@ -1461,6 +1627,21 @@ class InferenceEngine:
             raise EngineStepFailed(
                 f"RBT_FAULT_INJECT: simulated engine step failure at "
                 f"step {self.steps}")
+
+    def _swap_fault_hit(self) -> bool:
+        """RBT_FAULT_INJECT=swapfail:K hook: True exactly once, on the
+        Kth host-tier copy attempt (swap-out and swap-in attempts both
+        count). The caller treats it as a failed copy and degrades —
+        drop instead of swap-out, recompute instead of swap-in — with
+        no crash and no leaked host or HBM pages (tests/test_kv_tier.py
+        asserts the refcount balance)."""
+        if self._swap_fault is None:
+            return False
+        self._swap_fault -= 1
+        if self._swap_fault <= 0:
+            self._swap_fault = None
+            return True
+        return False
 
     def _expire_deadlines(self) -> List[int]:
         """Finish requests whose wall-clock deadline passed (between decode

@@ -84,6 +84,22 @@ DEFAULT_SCRAPE_INTERVAL_S = 2.0
 # a shared prefix elsewhere is cheaper than queueing behind this much work.
 PREFIX_SPILL_QUEUE = 8
 
+# Per-class scaling of the spill threshold (docs/paged-kv.md "Host tier
+# and preemption"): batch work forfeits its prefix preference at half
+# the queue depth (it can afford the re-prefill elsewhere), interactive
+# work holds its cache locality twice as deep (TTFT is its SLO). The
+# keys are the serve tier's QoS classes (serve/engine.py PRIORITY_RANK).
+SPILL_SCALE = {"interactive": 2.0, "standard": 1.0, "batch": 0.5}
+
+# Failover budget for QoS-shed 429s, per class. A 429 now carries a
+# load-derived Retry-After (serve/api.py): under fleet-wide overload,
+# hammering the shed request across every remaining backend just
+# multiplies the load that caused the shed. Each class gets a bounded
+# number of 429-driven failover hops; past the budget the shed (and its
+# Retry-After hint) passes through to the client. Unreachable-replica
+# failover stays unbounded — a down backend is not backpressure.
+SHED_RETRY_BUDGET = {"interactive": 3, "standard": 2, "batch": 1}
+
 
 def text_blocks(text: str, block_chars: int = DEFAULT_BLOCK_CHARS,
                 max_blocks: int = MAX_KEY_BLOCKS) -> List[str]:
@@ -338,12 +354,15 @@ class Router:
         return rep.active_slots + rep.queue_depth + 2.0 * rep.inflight
 
     def pick(self, blocks: Sequence, session_key: Optional[str] = None,
-             ) -> List[Tuple[str, str]]:
+             priority: str = "standard") -> List[Tuple[str, str]]:
         """Ranked (replica_name, reason) candidates for one request.
         Reason of the head pick: ``affinity`` (session ring owner),
         ``prefix`` (longest shadow match won), ``load`` (no prefix signal
         — least loaded), or ``random`` (policy=random). Later entries are
-        the failover order (reason ``failover``)."""
+        the failover order (reason ``failover``). ``priority`` scales the
+        prefix-spill threshold (SPILL_SCALE): batch traffic spills off a
+        queued replica before interactive traffic does."""
+        spill = self.spill_queue * SPILL_SCALE.get(priority, 1.0)
         with self._lock:
             healthy = [r for r in self._replicas.values() if r.healthy]
             if not healthy:
@@ -354,10 +373,11 @@ class Router:
                 return [(r.name, "random" if i == 0 else "failover")
                         for i, r in enumerate(order)]
             match = {r.name: r.shadow.match(blocks) for r in healthy}
-            # Deep queues forfeit prefix preference: past spill_queue the
-            # queue wait dominates what the prefix hit would save.
+            # Deep queues forfeit prefix preference: past the (class-
+            # scaled) spill threshold the queue wait dominates what the
+            # prefix hit would save.
             score = {r.name: (match[r.name]
-                              if r.queue_depth < self.spill_queue else 0)
+                              if r.queue_depth < spill else 0)
                      for r in healthy}
             ranked = sorted(
                 healthy,
@@ -591,13 +611,24 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                 {"error": {"message": "invalid JSON body"}}, status=400)
         blocks = _blocks_for(body, chat)
         session_key = _session_key(request, body)
+        # QoS class for routing: body field beats the X-Priority header;
+        # an unknown value routes as standard but still forwards
+        # verbatim, so the replica's validation (400) stays the single
+        # source of truth on the public surface.
+        raw_priority = body.get("priority")
+        if not isinstance(raw_priority, str):
+            raw_priority = request.headers.get("X-Priority", "")
+        route_class = (raw_priority.lower()
+                       if raw_priority.lower() in SHED_RETRY_BUDGET
+                       else "standard")
         reg.inc("gateway_requests_total",
                 help_text="Requests accepted by the gateway.")
         if session_key:
             reg.inc("gateway_affinity_requests_total",
                     help_text="Requests carrying a session key "
                               "(X-Session-Id or user).")
-        candidates = router.pick(blocks, session_key)
+        candidates = router.pick(blocks, session_key,
+                                 priority=route_class)
         if _trace_event("route"):
             instant("route_decision", request_id=rid,
                     backend=candidates[0][0] if candidates else "-",
@@ -623,6 +654,8 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
         last_status, last_body = 503, {"error": {
             "message": "every replica rejected the request",
             "type": "overloaded"}}
+        last_retry_after = "2"
+        shed_retries = 0  # 429-driven failover hops burned so far
         for i, (name, reason) in enumerate(candidates):
             remaining = None
             if deadline is not None:
@@ -656,6 +689,11 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
             # traceparent carries the W3C context — one id, one trace,
             # gateway span + replica spans.
             fwd_headers = {"X-Request-Id": rid, "traceparent": tp_out}
+            if raw_priority:
+                # Forward the class verbatim (header form): the replica
+                # orders its admission queue and picks preemption
+                # victims by it (serve/engine.py PRIORITY_RANK).
+                fwd_headers["X-Priority"] = raw_priority
             proxy_span = (span("proxy", request_id=rid, backend=name,
                                reason=reason, hop=i)
                           if _trace_event("proxy") else None)
@@ -700,6 +738,26 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                         last_body = await resp.json()
                     except Exception:  # noqa: BLE001 — non-JSON error body
                         last_body = {"error": {"message": "overloaded"}}
+                    last_retry_after = resp.headers.get(
+                        "Retry-After", last_retry_after)
+                    if resp.status == 429:
+                        # QoS shed with a load-derived Retry-After: honor
+                        # the hint past a bounded per-class budget instead
+                        # of hammering every remaining backend with work
+                        # the fleet just said it cannot absorb.
+                        if shed_retries >= SHED_RETRY_BUDGET[route_class]:
+                            reg.inc("gateway_shed_passthrough_total",
+                                    **{"class": route_class},
+                                    help_text="QoS-shed 429s returned to "
+                                              "the client after the per-"
+                                              "class retry budget, with "
+                                              "the replica's Retry-After "
+                                              "hint intact.")
+                            if _trace_event("retry"):
+                                instant("shed_passthrough", request_id=rid,
+                                        backend=name, qos=route_class)
+                            break
+                        shed_retries += 1
                     retry_reason = ("overloaded" if resp.status == 429
                                     else "draining")
                     reg.inc("gateway_retries_total", reason=retry_reason)
@@ -747,8 +805,8 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                 router.inflight_add(name, -1)
         return web.json_response(
             last_body, status=last_status,
-            headers={"Retry-After": "2"} if last_status in (429, 503)
-            else {})
+            headers={"Retry-After": last_retry_after}
+            if last_status in (429, 503) else {})
 
     async def completions(request):
         return await _proxy(request, chat=False)

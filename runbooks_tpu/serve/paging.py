@@ -67,6 +67,7 @@ from runbooks_tpu.obs.trace import complete as trace_complete
 from runbooks_tpu.obs.trace import record_enabled, span
 from runbooks_tpu.ops.sampling import sample, speculative_verify
 from runbooks_tpu.serve.engine import (
+    PRIORITY_RANK,
     EngineStepFailed,
     InferenceEngine,
     Request,
@@ -184,11 +185,118 @@ class PageAllocator:
 
 
 # ---------------------------------------------------------------------------
+# Host swap tier (docs/paged-kv.md "Host tier")
+# ---------------------------------------------------------------------------
+
+class HostPagePool:
+    """Host-RAM staging tier under the device page pool.
+
+    When the radix tree must evict an HBM page, the page's K/V copies
+    into one of these preallocated host buffers instead of dropping —
+    the node survives as *host-resident* and a later admission that
+    matches it swaps the page back into HBM (`device_put`-class cost)
+    instead of recomputing the prefix from scratch. Buffers are plain
+    pinned numpy arrays, allocated ONCE at construction: steady-state
+    swap traffic does zero host allocation, and the arrays' dtype is
+    exactly the device pool's (int8 + f32 scales when quantized,
+    activation dtype otherwise) so a swap round-trip is bit-identical.
+
+    Single-threaded like the engine that owns it (all mutation happens
+    on the serving thread); the ints /metrics reads are safe racily.
+    Sizing guidance (`kv_host_pages` from host-RAM headroom) lives in
+    docs/paged-kv.md.
+    """
+
+    def __init__(self, cfg: ModelConfig, host_pages: int, page_size: int,
+                 quantize_kv: bool = False):
+        if host_pages < 1:
+            raise ValueError(
+                f"kv_host_pages must be >= 1 to enable the host tier, "
+                f"got {host_pages}")
+        self.num_pages = int(host_pages)
+        self.page_size = int(page_size)
+        self.quantized = bool(quantize_kv)
+        dtype = np.dtype(jnp.int8 if quantize_kv
+                         else cfg.activation_dtype)
+        shape = (self.num_pages, cfg.num_layers, self.page_size,
+                 cfg.num_kv_heads, cfg.head_dim)
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.k = np.zeros(shape, dtype)
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.v = np.zeros(shape, dtype)
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.k_scale = (np.zeros(shape[:-1], np.float32)
+                        if quantize_kv else None)
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.v_scale = (np.zeros(shape[:-1], np.float32)
+                        if quantize_kv else None)
+        # pop() hands out ascending ids — deterministic tests.
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self._used = np.zeros(self.num_pages, bool)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(x.nbytes) for x in (self.k, self.v, self.k_scale,
+                                           self.v_scale) if x is not None)
+
+    @property
+    def bytes_per_page(self) -> int:
+        return self.nbytes // self.num_pages
+
+    def alloc(self) -> Optional[int]:
+        """One free host slot, or None — the caller decides whether to
+        make room (RadixTree.evict_host) or degrade to dropping."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._used[slot] = True
+        return slot
+
+    def free(self, slot: int) -> None:
+        if not self._used[slot]:
+            raise RuntimeError(f"free of unallocated host page {slot}")
+        self._used[slot] = False
+        self._free.append(slot)
+
+    def store(self, slot: int, k, v, k_scale=None, v_scale=None) -> None:
+        """Copy one page's K/V (shape [layers, page_size, kv_heads,
+        head_dim], already pulled to host) into the slot's buffer."""
+        if not self._used[slot]:
+            raise RuntimeError(f"store to unallocated host page {slot}")
+        self.k[slot] = k
+        self.v[slot] = v
+        if self.quantized:
+            self.k_scale[slot] = k_scale
+            self.v_scale[slot] = v_scale
+
+    def load(self, slot: int) -> tuple:
+        """The slot's page payload, as the operand tuple the swap-in
+        program takes (scales included exactly when quantized)."""
+        if not self._used[slot]:
+            raise RuntimeError(f"load of unallocated host page {slot}")
+        if self.quantized:
+            return (self.k[slot], self.v[slot],
+                    self.k_scale[slot], self.v_scale[slot])
+        return (self.k[slot], self.v[slot])
+
+
+# ---------------------------------------------------------------------------
 # Radix tree over token prefixes (page granularity)
 # ---------------------------------------------------------------------------
 
 class _RadixNode:
-    __slots__ = ("children", "page", "parent", "edge", "last_used")
+    __slots__ = ("children", "page", "parent", "edge", "last_used",
+                 "host_slot")
 
     def __init__(self, parent=None, edge=None, page: int = -1):
         self.children: Dict[tuple, "_RadixNode"] = {}
@@ -196,6 +304,10 @@ class _RadixNode:
         self.parent = parent
         self.edge = edge
         self.last_used = 0
+        # >= 0: the page's K/V live in the host tier (page is then -1).
+        # A node owns exactly one residency — HBM page, host slot, or
+        # neither (namespace stubs only).
+        self.host_slot = -1
 
 
 class RadixTree:
@@ -215,9 +327,21 @@ class RadixTree:
         self.page_size = page_size
         self.allocator = allocator
         self.root = _RadixNode()
-        self.nodes = 0            # pages currently owned by the tree
-        self.pages_evicted = 0    # cumulative (observability)
+        self.nodes = 0            # HBM pages currently owned by the tree
+        self.pages_evicted = 0    # cumulative HBM evictions (observability)
         self._clock = 0           # logical LRU clock (match/insert ticks)
+        # Host swap tier, wired by the paged engine when kv_host_pages
+        # > 0 (PagedInferenceEngine._wire_host_tier). None = eviction
+        # drops pages, the pre-host-tier behavior.
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.host: Optional[HostPagePool] = None
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.swap_out = None  # engine callback: page -> Optional[host slot]
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.host_nodes = 0          # nodes resident only in the host tier
+        self.pages_swapped_out = 0   # cumulative HBM -> host demotions
+        self.pages_swap_dropped = 0  # evictions that found no host room
+        self.host_pages_evicted = 0  # host-tier LRU drops (evict_host)
 
     def _tick(self) -> int:
         self._clock += 1
@@ -241,26 +365,35 @@ class RadixTree:
             self.root.children[key] = node
         return node
 
-    def match(self, tokens, ns=None) -> List[int]:
-        """Physical pages for the longest full-page prefix of ``tokens``
-        present in the tree (possibly empty), within the ``ns`` adapter
-        namespace. Refreshes LRU recency on the matched path. Does NOT
-        take references — the caller increfs when it commits to using
-        the pages."""
+    def match_nodes(self, tokens, ns=None) -> List["_RadixNode"]:
+        """Nodes for the longest full-page prefix of ``tokens`` present
+        in EITHER tier — HBM (page >= 0) or host-resident (host_slot >=
+        0) — within the ``ns`` adapter namespace. Refreshes LRU recency
+        on the matched path (in both tiers: a matched host node is the
+        one evict_host must NOT drop). Does NOT take references — the
+        caller commits via PagedKVManager.admit, which pins HBM matches
+        and promotes host ones."""
         ps = self.page_size
         node = self._root_for(ns)
         if node is None:
             return []
-        pages: List[int] = []
+        out: List[_RadixNode] = []
         now = self._tick()
         for i in range(len(tokens) // ps):
             child = node.children.get(tuple(tokens[i * ps:(i + 1) * ps]))
             if child is None:
                 break
             child.last_used = now
-            pages.append(child.page)
+            out.append(child)
             node = child
-        return pages
+        return out
+
+    def match(self, tokens, ns=None) -> List[int]:
+        """Per-node page ids for the longest matched prefix (host-
+        resident nodes report -1: resident, but not yet in HBM). Length
+        is what prefix-presence callers (has_prefix, register_prefix)
+        care about; admission uses match_nodes directly."""
+        return [n.page for n in self.match_nodes(tokens, ns=ns)]
 
     def insert(self, tokens, pages, ns=None) -> int:
         """Adopt ``pages[i]`` as the shared page for the i-th full page
@@ -282,48 +415,146 @@ class RadixTree:
                 self.allocator.incref([child.page])
                 self.nodes += 1
                 adopted += 1
+            elif child.page < 0 and child.host_slot >= 0:
+                # Free promotion: the releasing slot just held this very
+                # span's K/V in HBM (same tokens, same namespace, so the
+                # bytes are identical by construction) — adopt its page
+                # and retire the host copy, skipping a future swap-in.
+                child.page = int(pages[i])
+                self.allocator.incref([child.page])
+                if self.host is not None:
+                    self.host.free(child.host_slot)
+                child.host_slot = -1
+                self.host_nodes -= 1
+                self.nodes += 1
+                adopted += 1
             child.last_used = now
             node = child
         return adopted
 
-    def _leaves(self) -> List[_RadixNode]:
-        out, stack = [], [self.root]
+    def _resident_flags(self):
+        """(order, hbm_desc): every node in parent-before-child order,
+        and per node whether any STRICT descendant holds an HBM page.
+        One linear walk — eviction candidacy in both tiers keys on it
+        (a node with HBM descendants cannot leave the tree: dropping it
+        would orphan the descendants' tree references)."""
+        order: List[_RadixNode] = []
+        stack = [self.root]
         while stack:
             n = stack.pop()
-            for c in n.children.values():
-                if c.children:
-                    stack.append(c)
-                elif c.page >= 0:   # namespace stubs own no page
-                    out.append(c)
-        return out
+            order.append(n)
+            stack.extend(n.children.values())
+        hbm_desc: Dict[int, bool] = {}
+        for n in reversed(order):   # children before parents
+            hbm_desc[id(n)] = any(c.page >= 0 or hbm_desc[id(c)]
+                                  for c in n.children.values())
+        return order, hbm_desc
+
+    def _has_hbm_descendant(self, node: _RadixNode) -> bool:
+        stack = list(node.children.values())
+        while stack:
+            c = stack.pop()
+            if c.page >= 0:
+                return True
+            stack.extend(c.children.values())
+        return False
+
+    def _drop_subtree(self, v: _RadixNode) -> int:
+        """Unlink ``v`` and its whole subtree, dropping the tree's
+        ownership of every page in it: HBM pages decref (a slot still
+        sharing one keeps it alive — only the tree's reference goes),
+        host slots free. Returns host slots freed."""
+        del v.parent.children[v.edge]
+        host_freed = 0
+        stack = [v]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children = {}
+            if n.page >= 0:
+                self.allocator.decref([n.page])
+                self.nodes -= 1
+                n.page = -1
+            if n.host_slot >= 0:
+                self.host.free(n.host_slot)
+                n.host_slot = -1
+                self.host_nodes -= 1
+                host_freed += 1
+        return host_freed
 
     def evict(self, want: int) -> int:
-        """Free up to ``want`` pages by dropping least-recently-used
-        leaves whose pages no live slot references (allocator refcount
-        == 1, i.e. tree-only). Dropping a leaf can expose its parent as
-        the next candidate; the parent is pushed into the same LRU heap
-        instead of re-walking the tree per round, so eviction on the
-        admission path is O((leaves + freed) log n) even for deep cold
-        chains. Returns the number of pages freed."""
-        freed = 0
-        heap = [(n.last_used, id(n), n) for n in self._leaves()
-                if self.allocator.refcount(n.page) == 1]
+        """Free up to ``want`` HBM pages from least-recently-used
+        eviction candidates: nodes whose page only the tree references
+        (allocator refcount == 1) and with no HBM-resident strict
+        descendant — the generalization of "leaf" once host-resident
+        interior nodes can grow fresh HBM children beneath them (it
+        degenerates to exactly the old leaf rule when no host tier is
+        configured). With a host tier, a victim's page COPIES to a host
+        buffer via the engine's swap_out callback and the node survives
+        as host-resident (a later admission swaps it back in); without
+        one — or when the copy fails (swapfail fault) or the host tier
+        stays full after its own LRU pass — the node and its host-only
+        subtree drop. Freeing a victim can expose its parent as the
+        next candidate; the parent joins the same LRU heap instead of
+        re-walking the tree per round. Returns HBM pages freed."""
+        order, hbm_desc = self._resident_flags()
+        heap = [(n.last_used, id(n), n) for n in order
+                if n.page >= 0 and not hbm_desc[id(n)]
+                and self.allocator.refcount(n.page) == 1]
         heapq.heapify(heap)
+        freed = 0
         while heap and freed < want:
             _, _, v = heapq.heappop(heap)
-            del v.parent.children[v.edge]
-            self.allocator.decref([v.page])
-            self.nodes -= 1
+            page = v.page
+            slot = None
+            if self.host is not None and self.swap_out is not None:
+                slot = self.swap_out(page)
+            if slot is not None:
+                # Demote: the HBM page frees, the node lives on pointing
+                # at its host copy, and its subtree stays matchable.
+                self.allocator.decref([page])
+                self.nodes -= 1
+                v.page = -1
+                v.host_slot = int(slot)
+                self.host_nodes += 1
+                self.pages_swapped_out += 1
+            else:
+                if self.host is not None:
+                    self.pages_swap_dropped += 1
+                self._drop_subtree(v)
             freed += 1
             p = v.parent
             # Refcounts can't move under us (eviction runs on the single
             # serving thread), so a pinned parent is skipped for good —
             # exactly the pin-before-evict contract _admit relies on.
             # Namespace stubs (page < 0) never enter the heap.
-            if (p is not self.root and not p.children and p.page >= 0
-                    and self.allocator.refcount(p.page) == 1):
+            if (p is not self.root and p.page >= 0
+                    and self.allocator.refcount(p.page) == 1
+                    and not self._has_hbm_descendant(p)):
                 heapq.heappush(heap, (p.last_used, id(p), p))
         self.pages_evicted += freed
+        return freed
+
+    def evict_host(self, want: int) -> int:
+        """Make room in the HOST tier: drop up to ``want`` host slots
+        from least-recently-used host-resident nodes with no HBM
+        descendant (their subtrees are host-only, so dropping leaks
+        nothing). Called by the engine's swap_out callback when the
+        host pool is full — the returning-session bet is freshness-
+        weighted at both tiers. Returns host slots freed."""
+        if self.host is None or want < 1:
+            return 0
+        order, hbm_desc = self._resident_flags()
+        heap = [(n.last_used, id(n), n) for n in order
+                if n.host_slot >= 0 and not hbm_desc[id(n)]]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < want:
+            _, _, v = heapq.heappop(heap)
+            if v.host_slot < 0:
+                continue   # freed by an earlier victim's subtree drop
+            freed += self._drop_subtree(v)
+        self.host_pages_evicted += freed
         return freed
 
 
@@ -676,6 +907,49 @@ def make_paged_verify_fn(cfg: ModelConfig, draft_tokens: int,
     return paged_verify_fn
 
 
+def make_kv_swap_out_fn():
+    """One radix page, pool -> host: gather page ``page``'s K/V (plus
+    scales when quantized) out of the pool so the host can pull and
+    store it. The page index is a TRACED operand, so every swap-out of
+    any page is the same compiled program — one warmup call covers all
+    steady-state swap traffic (the PR-14 adapter page-in discipline).
+    The pool is donated and returned unchanged (input-output aliasing:
+    zero copy), keeping the caller's cache-threading identical to every
+    other paged program."""
+
+    def kv_swap_out_fn(pool, page):
+        quantized = pool.k.dtype == jnp.int8
+        out = (pool.k[:, page], pool.v[:, page],
+               pool.k_scale[:, page] if quantized else None,
+               pool.v_scale[:, page] if quantized else None)
+        return out, pool
+
+    return kv_swap_out_fn
+
+
+def make_kv_swap_in_fn():
+    """One radix page, host -> pool: splice a host-resident page's K/V
+    back into physical page ``page`` of the donated pool, in place.
+    Payload operands arrive as plain (uncommitted) numpy arrays — the
+    HostPagePool buffers themselves — and the page index as np.int32,
+    at warmup AND at runtime: committed device arrays would key a
+    different jit entry and compile on the serving thread (the
+    lora_pool lesson)."""
+
+    def kv_swap_in_fn(pool, page, k_page, v_page, k_scale=None,
+                      v_scale=None):
+        quantized = pool.k.dtype == jnp.int8
+        k = pool.k.at[:, page].set(k_page.astype(pool.k.dtype))
+        v = pool.v.at[:, page].set(v_page.astype(pool.v.dtype))
+        ks = (pool.k_scale.at[:, page].set(k_scale) if quantized
+              else None)
+        vs = (pool.v_scale.at[:, page].set(v_scale) if quantized
+              else None)
+        return PagePool(k=k, v=v, k_scale=ks, v_scale=vs)
+
+    return kv_swap_in_fn
+
+
 # ---------------------------------------------------------------------------
 # Host-side paging state
 # ---------------------------------------------------------------------------
@@ -699,44 +973,106 @@ class PagedKVManager:
         self.slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
         self.slot_shared = np.zeros(max_slots, np.int32)  # leading shared
         self.pages_reused_total = 0   # radix hits, counted PER PAGE
+        # Engine callback for promoting host-resident matches at
+        # admission: (host_slot, dest_page) -> bool. None until the
+        # paged engine wires the host tier.
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.swap_in = None
+        self.pages_swapped_in = 0     # cumulative host -> HBM promotions
 
     def plan(self, prompt_tokens, max_tokens: int,
-             max_seq_len: int, ns=None) -> Tuple[List[int], int]:
-        """(shared_pages, private_needed) for admitting this prompt.
-        Shared = the radix tree's longest full-page match, capped so at
-        least one prompt token remains to prefill (sampling needs a real
-        suffix logit). Private pages reserve the whole generation up
-        front — ceil(min(prompt+max_tokens, max_seq_len) / page_size)
-        minus the shared pages — so an admitted request can never die
-        mid-generation to page exhaustion (admission is the only
-        backpressure point: no preemption machinery, no corruption)."""
+             max_seq_len: int, ns=None) -> Tuple[List[_RadixNode], int]:
+        """(shared_nodes, private_needed) for admitting this prompt.
+        Shared = the radix tree's longest full-page match across BOTH
+        tiers (HBM pages and host-resident copies — admit() swaps the
+        latter back in), capped so at least one prompt token remains to
+        prefill (sampling needs a real suffix logit). Private pages
+        reserve the whole generation up front — ceil(min(prompt +
+        max_tokens, max_seq_len) / page_size) minus the shared pages —
+        so an admitted request can never die mid-generation to page
+        exhaustion (admission and explicit QoS preemption are the only
+        backpressure points: no corruption)."""
         ps = self.page_size
         n = len(prompt_tokens)
         shareable = ((n - 1) // ps) * ps
-        shared = self.radix.match(prompt_tokens[:shareable], ns=ns)
+        shared = self.radix.match_nodes(prompt_tokens[:shareable], ns=ns)
         reserve = min(n + max_tokens, max_seq_len)
         total_pages = -(-reserve // ps)
         return shared, max(total_pages - len(shared), 0)
 
-    def admit(self, slot: int, shared: List[int],
+    def admit(self, slot: int, shared: List[_RadixNode],
               private_n: int) -> Optional[List[int]]:
         """Commit an admission: evict unreferenced prefix pages if the
         free list is short, allocate the private pages, take references
-        on the shared ones, and build the slot's page table. Returns the
-        private pages, or None when the pool cannot satisfy the plan
-        (caller leaves the request queued — queue backpressure, not
-        corruption)."""
-        # Pin the matched pages BEFORE evicting: the planned shared
-        # pages may be tree-only (refcount 1) and would otherwise be
-        # legal eviction victims for their own admission.
-        self.allocator.incref(shared)
-        if private_n > self.allocator.free_count:
-            self.radix.evict(private_n - self.allocator.free_count)
-        priv = self.allocator.alloc(private_n)
-        if priv is None:
-            self.allocator.decref(shared)
+        on the shared ones — swapping host-resident matches back into
+        fresh HBM pages first, so a returning session pays a device_put
+        instead of re-prefilling its history — and build the slot's
+        page table. Returns the private pages, or None when the pool
+        cannot satisfy the plan (caller leaves the request queued —
+        queue backpressure, not corruption). On a swap-in failure the
+        whole admission rolls back ref-for-ref and the failed node
+        drops from the tree, so the next plan's shorter match simply
+        recomputes those tokens — degrade, never crash or leak."""
+        # Pin the HBM-resident matches BEFORE evicting: the planned
+        # shared pages may be tree-only (refcount 1) and would
+        # otherwise be legal eviction victims for their own admission.
+        hbm_pins = [nd.page for nd in shared if nd.page >= 0]
+        self.allocator.incref(hbm_pins)
+        n_promote = sum(1 for nd in shared if nd.page < 0)
+        need = private_n + n_promote
+        if need > self.allocator.free_count:
+            self.radix.evict(need - self.allocator.free_count)
+        fresh = self.allocator.alloc(need)
+        if fresh is None or any(nd.page < 0 and nd.host_slot < 0
+                                for nd in shared):
+            # Pool can't satisfy the plan — or eviction's own host-tier
+            # LRU pass dropped one of the matched host nodes (possible
+            # only under extreme host pressure; the match refreshed
+            # their recency, so they are the LAST candidates). Roll
+            # back fully and let the caller re-plan.
+            if fresh is not None:
+                self.allocator.decref(fresh)
+            self.allocator.decref(hbm_pins)
             return None
-        pages = list(shared) + priv
+        pages: List[int] = []
+        promoted: List[int] = []
+        fi = 0
+        failed: Optional[_RadixNode] = None
+        for nd in shared:
+            if nd.page >= 0:
+                pages.append(nd.page)
+                continue
+            pg = fresh[fi]
+            if self.swap_in is None or not self.swap_in(nd.host_slot, pg):
+                failed = nd
+                break
+            # The fresh page's allocator ref transfers to the tree (it
+            # owned the host copy); the slot's share ref goes on top —
+            # refcount 2, exactly an HBM-resident shared page's shape.
+            self.radix.host.free(nd.host_slot)
+            nd.host_slot = -1
+            nd.page = int(pg)
+            self.radix.host_nodes -= 1
+            self.radix.nodes += 1
+            self.allocator.incref([pg])
+            self.pages_swapped_in += 1
+            promoted.append(pg)
+            pages.append(pg)
+            fi += 1
+        if failed is not None:
+            # Swap-in failed mid-promotion: drop the failed node (its
+            # HBM descendants, if any, only lose their TREE refs — the
+            # pins below still hold them until the final decref), undo
+            # the slot refs taken so far (already-promoted nodes keep
+            # their new HBM residency: that work is not wasted), and
+            # free the unused fresh pages.
+            self.radix._drop_subtree(failed)
+            self.allocator.decref(promoted)
+            self.allocator.decref(fresh[fi:])
+            self.allocator.decref(hbm_pins)
+            return None
+        priv = fresh[fi:]
+        pages.extend(priv)
         self.slot_pages[slot] = pages
         self.slot_shared[slot] = len(shared)
         self.page_table[slot, :] = self.trash_page
@@ -763,7 +1099,7 @@ class PagedKVManager:
         self.page_table[slot, :] = self.trash_page
 
     def occupancy(self) -> dict:
-        return {
+        occ = {
             "pages_total": self.num_pages,
             "pages_free": self.allocator.free_count,
             "pages_used": self.allocator.used_count,
@@ -771,6 +1107,20 @@ class PagedKVManager:
             "pages_reused_total": self.pages_reused_total,
             "pages_evicted_total": self.radix.pages_evicted,
         }
+        host = self.radix.host
+        if host is not None:
+            occ.update({
+                "host_pages_total": host.num_pages,
+                "host_pages_used": host.used_count,
+                "host_pages_free": host.free_count,
+                "host_resident_pages": self.radix.host_nodes,
+                "host_bytes": host.nbytes,
+                "swap_out_pages_total": self.radix.pages_swapped_out,
+                "swap_in_pages_total": self.pages_swapped_in,
+                "swap_dropped_pages_total": self.radix.pages_swap_dropped,
+                "host_pages_evicted_total": self.radix.host_pages_evicted,
+            })
+        return occ
 
 
 # ---------------------------------------------------------------------------
@@ -790,9 +1140,13 @@ class PagedInferenceEngine(InferenceEngine):
     size it DOWN from HBM headroom to overcommit on sharing
     (docs/paged-kv.md)."""
 
+    # Pages are the unit a preempted slot's state swaps at, so only the
+    # paged engine supports preemption="swap" (serve/engine.py gates).
+    _supports_preemption = True
+
     def __init__(self, cfg: ModelConfig, params: Params, *,
                  page_size: int = 16, num_pages: Optional[int] = None,
-                 **kwargs):
+                 kv_host_pages: int = 0, **kwargs):
         mesh = kwargs.get("mesh")
         if mesh is not None:
             # Precise mesh-geometry validation: each error names the one
@@ -816,6 +1170,10 @@ class PagedInferenceEngine(InferenceEngine):
             # (pipeline parallelism is a training-path feature).
         self.page_size = int(page_size)
         self._num_pages_arg = num_pages
+        if int(kv_host_pages) < 0:
+            raise ValueError(
+                f"kv_host_pages must be >= 0, got {kv_host_pages}")
+        self._kv_host_pages_arg = int(kv_host_pages)
         super().__init__(cfg, params, **kwargs)
 
     # -- storage -------------------------------------------------------
@@ -839,9 +1197,27 @@ class PagedInferenceEngine(InferenceEngine):
                 f"max-length sequence ({self.pages_per_slot} pages)")
         self.pager = PagedKVManager(self.num_pages, ps, self.max_slots,
                                     self.pages_per_slot)
+        # guarded-by: engine worker thread (single-threaded serving loop)
+        self.host_pool: Optional[HostPagePool] = None
+        self._wire_host_tier()
         self.cache = self._shard_pool(
             PagePool.create(self.cfg, self.num_pages, ps,
                             quantize_kv=self.quantize_kv))
+
+    def _wire_host_tier(self) -> None:
+        """(Re)attach the host swap tier to a fresh pager. The host pool
+        reallocates too: its copies pair with radix nodes of the pager
+        being replaced, so carrying them over would resurrect pages of
+        a discarded tree. No-op when kv_host_pages is 0 — eviction then
+        drops pages exactly as before the host tier existed."""
+        if self._kv_host_pages_arg <= 0:
+            return
+        self.host_pool = HostPagePool(self.cfg, self._kv_host_pages_arg,
+                                      self.page_size,
+                                      quantize_kv=self.quantize_kv)
+        self.pager.radix.host = self.host_pool
+        self.pager.radix.swap_out = self._kv_swap_out
+        self.pager.swap_in = self._kv_swap_in
 
     def _shard_pool(self, pool: PagePool) -> PagePool:
         """Lay the pool out under the serving mesh: kv-heads (axis 3 of
@@ -875,6 +1251,7 @@ class PagedInferenceEngine(InferenceEngine):
         pages lived in the doomed pool, so its content goes too."""
         self.pager = PagedKVManager(self.num_pages, self.page_size,
                                     self.max_slots, self.pages_per_slot)
+        self._wire_host_tier()
         self.cache = self._shard_pool(
             PagePool.create(self.cfg, self.num_pages, self.page_size,
                             quantize_kv=self.quantize_kv))
@@ -930,6 +1307,65 @@ class PagedInferenceEngine(InferenceEngine):
             return self._verify_fns[view_pages]
 
         self._verify_for = verify_for
+        if self._kv_host_pages_arg > 0:
+            self._swap_out_prog = jax.jit(make_kv_swap_out_fn(),
+                                          donate_argnums=(0,))
+            obs_device.PROGRAMS.register("serve", "kv_swap_out",
+                                         self._swap_out_prog)
+            self._swap_in_prog = jax.jit(make_kv_swap_in_fn(),
+                                         donate_argnums=(0,))
+            obs_device.PROGRAMS.register("serve", "kv_swap_in",
+                                         self._swap_in_prog)
+
+    # -- host swap tier (docs/paged-kv.md "Host tier") -----------------
+
+    def _kv_swap_out(self, page: int) -> Optional[int]:
+        """RadixTree eviction callback: copy one HBM page into a host
+        slot. Returns the host slot, or None to degrade to dropping
+        (host tier still full after its own LRU pass, or the injected
+        swapfail fault) — the tree then drops the node exactly as the
+        host-less path would."""
+        if self._swap_fault_hit():
+            return None
+        h = self.host_pool.alloc()
+        if h is None:
+            self.pager.radix.evict_host(1)
+            h = self.host_pool.alloc()
+            if h is None:
+                return None
+        t0 = time.perf_counter()
+        with self._mesh_ctx():
+            out, self.cache = self._swap_out_prog(self.cache,
+                                                  np.int32(page))
+            # rbt-check: ignore[device-sync] swap-out boundary — the page's bytes must land in host RAM before the HBM page frees
+            payload = tuple(np.asarray(x) for x in out if x is not None)
+        self.host_pool.store(h, *payload)
+        obs_metrics.REGISTRY.observe(
+            "serve_kv_swap_seconds", time.perf_counter() - t0,
+            direction="out",
+            help_text="Host-tier page copy wall time (dispatch + host "
+                      "sync), labeled by direction.")
+        return h
+
+    def _kv_swap_in(self, host_slot: int, page: int) -> bool:
+        """PagedKVManager promotion callback: splice one host-resident
+        page back into fresh HBM page ``page``. False = degrade to
+        recompute (injected swapfail fault): the manager aborts the
+        admission leak-free and the next plan simply prefills those
+        tokens."""
+        if self._swap_fault_hit():
+            return False
+        payload = self.host_pool.load(host_slot)
+        t0 = time.perf_counter()
+        with self._mesh_ctx():
+            self.cache = self._swap_in_prog(self.cache, np.int32(page),
+                                            *payload)
+        obs_metrics.REGISTRY.observe(
+            "serve_kv_swap_seconds", time.perf_counter() - t0,
+            direction="in",
+            help_text="Host-tier page copy wall time (dispatch + host "
+                      "sync), labeled by direction.")
+        return True
 
     def _view_pages_for(self, max_pos: int) -> int:
         """Smallest view-page bucket whose token extent covers every
@@ -1043,6 +1479,27 @@ class PagedInferenceEngine(InferenceEngine):
                         _, _, _, self.cache, _ = self._verify_for(vp)(
                             self.params, self.cache, *args, **akw)
                     n_verify += 1
+            n_swap = 0
+            if self._kv_host_pages_arg > 0:
+                # Swap splices warm against the trash page: the gather
+                # reads garbage and the splice writes a page nothing
+                # references — harmless, and EXACTLY the runtime operand
+                # signature (np.int32 page index, plain np host-page
+                # payloads; committed device operands would key a
+                # different jit entry — the lora_pool lesson).
+                pg = np.int32(self.pager.trash_page)
+                with self._mesh_ctx():
+                    record_cost("kv_swap_out", "page",
+                                self._swap_out_prog, self.cache, pg)
+                    out, self.cache = self._swap_out_prog(self.cache, pg)
+                    payload = tuple(np.asarray(x) for x in out
+                                    if x is not None)
+                with self._mesh_ctx():
+                    record_cost("kv_swap_in", "page", self._swap_in_prog,
+                                self.cache, pg, *payload)
+                    self.cache = self._swap_in_prog(self.cache, pg,
+                                                    *payload)
+                n_swap = 2
         census = obs_device.PROGRAMS.census("serve")
         self.warmup_census = {
             "prefill_programs": n_prefill,
@@ -1054,6 +1511,8 @@ class PagedInferenceEngine(InferenceEngine):
             "page_size": self.page_size,
             "num_pages": self.num_pages,
             "verify_programs": n_verify,
+            "swap_programs": n_swap,
+            "kv_host_pages": self._kv_host_pages_arg,
             "speculative": self.speculative,
             "draft_tokens": self.draft_tokens,
             "adapter_pool": (self.adapters.pool_size
@@ -1073,7 +1532,7 @@ class PagedInferenceEngine(InferenceEngine):
             f"{row_set}), {len(self.view_page_buckets)} decode views "
             f"(pages {self.view_page_buckets}), "
             f"{self.num_pages}x{self.page_size} pool, "
-            f"{n_verify} verify programs; "
+            f"{n_verify} verify programs, {n_swap} swap programs; "
             f"{self.warmup_census['compiles']} compiles in "
             f"{self.warmup_census['compile_seconds']}s", flush=True)
         if not self._marked_steady:
@@ -1161,22 +1620,41 @@ class PagedInferenceEngine(InferenceEngine):
     # -- admission -----------------------------------------------------
 
     def _admit(self, exclude_slots=()) -> None:
+        blocked = self._admit_pass(exclude_slots)
+        if (self.preemption == "swap" and blocked
+                and self._maybe_preempt(exclude_slots)):
+            # The victim's slot and pages freed at this step boundary:
+            # a second pass admits the better-class head NOW instead of
+            # a step later (TTFT under overload is the point).
+            self._admit_pass(exclude_slots)
+
+    def _admit_pass(self, exclude_slots=()) -> bool:
+        """One admission sweep over the free slots. Returns True when
+        the queue head is left blocked on CAPACITY (no free slot, page
+        exhaustion, or adapter-lane exhaustion) rather than on this
+        tick's prefill budget — the signal _admit's preemption pass
+        keys on (a budget-blocked head admits next step by itself;
+        preempting for it would churn)."""
         budget = self.prefill_budget
         admitted: List[tuple] = []
+        budget_blocked = False
         for slot in self._free_slots(exclude_slots):
             if not self.queue:
                 break
             head = self.queue[0]
             # Radix lookups are namespaced by adapter: a tenant's pages
             # only ever match the SAME adapter's prompts (the K/V values
-            # differ per adapter even for identical tokens).
+            # differ per adapter even for identical tokens). A preempted
+            # head plans against prompt + written outputs — its own
+            # adopted pages — so resume rides the shared-prefix path.
+            eff = self._admit_tokens(head)
             shared, private_n = self.pager.plan(
-                head.prompt_tokens, head.max_tokens, self.max_seq_len,
+                eff, self._admit_budget(head), self.max_seq_len,
                 ns=head.adapter)
-            suffix = (len(head.prompt_tokens)
-                      - len(shared) * self.page_size)
+            suffix = len(eff) - len(shared) * self.page_size
             need = self._bucket_for(suffix)
             if admitted and need > budget:
+                budget_blocked = True
                 break
             if not self._acquire_adapter(head):
                 # Adapter-pool exhaustion: same backpressure as page
@@ -1208,16 +1686,70 @@ class PagedInferenceEngine(InferenceEngine):
                                request_id=req.request_id, slot=slot)
             budget -= need
             admitted.append((slot, req, len(shared)))
-        if not admitted:
-            return
-        by_group: dict = {}
-        for slot, req, nshared in admitted:
-            b = self._bucket_for(len(req.prompt_tokens)
-                                 - nshared * self.page_size)
-            ppb = page_bucket(nshared, self.pages_per_slot)
-            by_group.setdefault((b, ppb), []).append((slot, req))
-        for (bucket, ppb), group in by_group.items():
-            self._prefill_group_paged(bucket, ppb, group)
+        if admitted:
+            by_group: dict = {}
+            for slot, req, nshared in admitted:
+                b = self._bucket_for(len(self._admit_tokens(req))
+                                     - nshared * self.page_size)
+                ppb = page_bucket(nshared, self.pages_per_slot)
+                by_group.setdefault((b, ppb), []).append((slot, req))
+            for (bucket, ppb), group in by_group.items():
+                self._prefill_group_paged(bucket, ppb, group)
+        return bool(self.queue) and not budget_blocked
+
+    # -- QoS preemption (docs/paged-kv.md "Preemption") ----------------
+
+    def _maybe_preempt(self, exclude_slots=()) -> bool:
+        """Preempt ONE active slot whose class is strictly worse than
+        the queue head's: worst class first, most-recently-admitted
+        within a class (least sunk work lost). One victim per step
+        bounds preemption churn — a storm can displace at most one
+        slot per step boundary, and only while a better-class request
+        is actually waiting. Returns True when a slot was preempted."""
+        head_rank = PRIORITY_RANK[self.queue[0].priority]
+        cands = [
+            (PRIORITY_RANK[self.slot_req[s].priority],
+             self.slot_req[s]._admitted, s)
+            for s in range(self.max_slots)
+            if self.active[s] and self.slot_req[s] is not None
+            and s not in exclude_slots
+            and PRIORITY_RANK[self.slot_req[s].priority] > head_rank]
+        if not cands:
+            return False
+        _, _, victim = max(cands)
+        self._preempt_slot(victim)
+        return True
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Displace one active slot at a step boundary. The written
+        extent (prompt + outputs[:-1] — the last sampled token is never
+        written; engine.py's cache invariant) adopts into the radix
+        tree exactly like a finished request's pages, so the state
+        survives in the HBM/host hierarchy; the request re-queues with
+        its generated tokens intact and resumes later via a radix match
+        on its own history (engine.py _activate_slot's resume branch) —
+        no token loss, finish_reason unchanged. The adapter lane stays
+        pinned: releasing it could park the resume behind the very
+        traffic that preempted it."""
+        req = self.slot_req[slot]
+        assert req is not None
+        m = len(req.output_tokens)
+        written = len(req.prompt_tokens) + max(0, m - 1)
+        toks = (req.prompt_tokens + req.output_tokens)[:written]
+        self.pager.release(slot, written_tokens=toks, ns=req.adapter)
+        self.active[slot] = False
+        self.slot_req[slot] = None
+        self.adapter_slots[slot] = -1
+        if self._spec_index is not None:
+            self._spec_index.clear(slot)
+        req._slot = -1
+        req._preempted = True
+        self.preemptions += 1
+        # Requeue at the tail of the request's own class, bypassing
+        # submit()'s admission bounds — shedding a preempted request
+        # would lose its generated tokens, the one thing preemption
+        # exists to avoid.
+        self._queue_insert(req)
 
     def _prefill_group_paged(self, bucket: int, ppb: int,
                              group: List[tuple]) -> None:
@@ -1247,8 +1779,12 @@ class PagedInferenceEngine(InferenceEngine):
             aslots[i] = req._adapter_lane
             nshared = int(self.pager.slot_shared[slot])
             plen = nshared * ps
-            m = len(req.prompt_tokens) - plen
-            tokens[i, :m] = req.prompt_tokens[plen:]
+            # Preemption-resume rows prefill the request's own written
+            # history past its adopted pages (engine.py _admit_tokens);
+            # fresh rows see eff == prompt_tokens unchanged.
+            eff = self._admit_tokens(req)
+            m = len(eff) - plen
+            tokens[i, :m] = eff[plen:]
             positions[i, :m] = np.arange(plen, plen + m)
             dest_pages[i] = self.pager.page_table[slot]
             if ppb:

@@ -105,6 +105,28 @@ def test_deep_queue_forfeits_prefix_preference():
     assert name == "b" and reason == "load"
 
 
+def test_spill_threshold_scales_with_qos_class():
+    r = Router({"a": "http://a", "b": "http://b"})
+    blocks = text_blocks("z" * 640)
+    r.record_route("a", blocks)
+    r.observe_metrics("b", fams(serve_active_slots=0,
+                                serve_queue_depth=0))
+    # Depth 5 on the prefix holder: batch spills at half the base
+    # threshold (8 * 0.5 = 4), standard still rides its prefix hit.
+    r.observe_metrics("a", fams(serve_active_slots=8,
+                                serve_queue_depth=5))
+    assert r.pick(blocks, priority="batch")[0] == ("b", "load")
+    assert r.pick(blocks, priority="standard")[0] == ("a", "prefix")
+    # Depth 12: standard spills past 8, interactive holds its cache
+    # locality to twice the base depth (TTFT is its SLO).
+    r.observe_metrics("a", fams(serve_active_slots=8,
+                                serve_queue_depth=12))
+    assert r.pick(blocks, priority="standard")[0] == ("b", "load")
+    assert r.pick(blocks, priority="interactive")[0] == ("a", "prefix")
+    # An unknown class routes with the standard threshold.
+    assert r.pick(blocks, priority="urgent")[0] == ("b", "load")
+
+
 def test_session_affinity_survives_replica_set_changes():
     r = Router({f"r{i}": f"http://r{i}" for i in range(4)})
     blocks = text_blocks("w" * 640)
@@ -330,6 +352,88 @@ def test_gateway_all_replicas_overloaded_propagates_429():
             assert resp.status == 429
             assert resp.headers.get("Retry-After")
             assert all(len(a["hits"]) == 1 for a in apps)
+        for s in servers:
+            await s.close()
+
+    run(drive())
+
+
+def test_gateway_forwards_priority_as_header():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        from aiohttp import web
+
+        app = web.Application()
+        seen = []
+
+        async def completions(request):
+            await request.json()
+            seen.append(request.headers.get("X-Priority"))
+            return web.json_response(ok_behavior({})[1])
+
+        app.router.add_post("/v1/completions", completions)
+        srv = TestServer(app)
+        await srv.start_server()
+        gw = create_gateway({"a": f"http://127.0.0.1:{srv.port}"},
+                            scrape_interval_s=0)
+        async with TestClient(TestServer(gw)) as client:
+            # Body field forwards as the header the replica's admission
+            # path reads; the raw header forwards verbatim too.
+            r = await client.post("/v1/completions", json={
+                "prompt": "x", "priority": "batch"})
+            assert r.status == 200
+            r = await client.post("/v1/completions", json={"prompt": "x"},
+                                  headers={"X-Priority": "interactive"})
+            assert r.status == 200
+            r = await client.post("/v1/completions", json={"prompt": "x"})
+            assert r.status == 200
+        assert seen == ["batch", "interactive", None]
+        await srv.close()
+
+    run(drive())
+
+
+def test_gateway_shed_retry_budget_bounds_429_failover():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        # Three overloaded replicas: a batch request (budget 1) burns
+        # one 429-driven failover hop, then the shed passes through to
+        # the client with the REPLICA's Retry-After hint — the third
+        # replica never sees work the fleet just said it cannot absorb.
+        apps = [fake_replica(n, lambda b: (429, {
+            "error": {"message": "full", "type": "overloaded"}}))
+            for n in ("a", "b", "c")]
+        servers = []
+        for app in apps:
+            srv = TestServer(app)
+            await srv.start_server()
+            servers.append(srv)
+        reg = obs_metrics.Registry()
+        gw = create_gateway(
+            {n: f"http://127.0.0.1:{s.port}"
+             for n, s in zip(("a", "b", "c"), servers)},
+            scrape_interval_s=0, registry=reg)
+        async with TestClient(TestServer(gw)) as client:
+            resp = await client.post("/v1/completions", json={
+                "prompt": "x", "priority": "batch"})
+            assert resp.status == 429
+            # fake_replica answers Retry-After: 1; the gateway's own
+            # fallthrough default is 2 — seeing 1 proves passthrough.
+            assert resp.headers.get("Retry-After") == "1"
+            assert sum(len(a["hits"]) for a in apps) == 2
+        assert reg.counter_value("gateway_shed_passthrough_total",
+                                 **{"class": "batch"}) == 1
+        # An interactive request gets the full replica sweep: budget 3
+        # covers both failover hops before candidates run out.
+        async with TestClient(TestServer(gw)) as client:
+            resp = await client.post("/v1/completions", json={
+                "prompt": "y", "priority": "interactive"})
+            assert resp.status == 429
+            assert sum(len(a["hits"]) for a in apps) == 5
+        assert reg.counter_value("gateway_shed_passthrough_total",
+                                 **{"class": "interactive"}) == 0
         for s in servers:
             await s.close()
 

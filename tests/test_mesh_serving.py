@@ -204,6 +204,60 @@ def test_mesh_zero_unexpected_compiles_in_steady_loop(model, mesh):
         engine.release_steady()
 
 
+def test_mesh_host_tier_swap_and_preemption_zero_compiles(model, mesh):
+    """Host KV tier + QoS preemption under tensor=2: eviction demotes
+    each chip's kv-head shard of the page to the host buffer, a
+    returning match swaps it back in, and a batch slot preempts for an
+    interactive queue head — token-for-token identical to the
+    single-device engine, with zero unexpected compiles across the
+    swap-out, swap-in, and preemption-resume paths
+    (docs/paged-kv.md "Host tier and preemption")."""
+    from runbooks_tpu.obs import device as obs_device
+
+    cfg, params = model
+    shared = list(range(1, 33))
+
+    def run(mesh_):
+        engine = PagedInferenceEngine(cfg, params, max_slots=1,
+                                      page_size=16, num_pages=5,
+                                      kv_host_pages=8, preemption="swap",
+                                      decode_chunk=2, mesh=mesh_)
+        engine.warmup()
+        sentinel = obs_device.SENTINEL
+        before = sentinel.unexpected
+        try:
+            engine.register_prefix(shared)
+            # demote both (sharded) prefix pages to host RAM
+            assert engine.pager.radix.evict(10 ** 6) == 2
+            ret = Request(prompt_tokens=shared + [50], max_tokens=5,
+                          temperature=0.0)
+            engine.submit(ret)        # admission swaps the prefix back in
+            while not ret.finished:
+                engine.step()
+            batch = Request(prompt_tokens=list(shared), max_tokens=16,
+                            temperature=0.0, priority="batch")
+            engine.submit(batch)
+            for _ in range(3):        # admit + decode a few tokens
+                engine.step()
+            inter = Request(prompt_tokens=list(range(90, 106)),
+                            max_tokens=8, temperature=0.0,
+                            priority="interactive")
+            engine.submit(inter)      # displaces the batch slot
+            while engine.has_work():
+                engine.step()
+            assert engine.pager.radix.pages_swapped_out >= 2
+            assert engine.pager.pages_swapped_in >= 2
+            assert engine.preemptions == 1 == engine.preempted_resumed
+            assert sentinel.unexpected == before, \
+                sentinel.recent_unexpected()
+            return [ret.output_tokens, batch.output_tokens,
+                    inter.output_tokens]
+        finally:
+            engine.release_steady()
+
+    assert run(mesh) == run(None)
+
+
 # ---------------------------------------------------------------------------
 # Per-device HBM accounting
 # ---------------------------------------------------------------------------
