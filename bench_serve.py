@@ -95,6 +95,19 @@ Acceptance: >= 1.5x decode tok/s at the high-accept bucket
 (value = speedup, vs_baseline = speedup / 1.5) with zero unexpected
 XLA compiles across every steady loop (gate: vs_baseline forced to 0
 on any unexpected compile).
+
+RBT_BENCH_GRAMMAR=1 runs the grammar-constrained decoding axis
+(docs/structured-output.md): the SAME workload on one grammar-on
+engine, first unconstrained (all-allow mask rows — the identity
+operand) then constrained by a bounded JSON schema, reporting decode
+tok/s for both plus the parse rate over constrained completions (every
+output must finish grammar_complete and json.loads). The mask apply is
+one elementwise `where` per dispatch and the masked program variants
+REPLACE the plain set, so the constrained pass must neither compile
+anything new nor fall off the throughput cliff. Acceptance:
+constrained >= 0.7x unconstrained decode tok/s (value = ratio,
+vs_baseline = ratio / 0.7), forced to 0 on any unexpected compile or
+any constrained output that fails to parse (parse rate < 100%).
 """
 
 from __future__ import annotations
@@ -976,6 +989,127 @@ def spec_inner() -> None:
     }))
 
 
+def grammar_inner() -> None:
+    """Grammar-constrained vs unconstrained decode tok/s on ONE engine.
+
+    Both passes share the grammar-on engine (and therefore the jit
+    cache): the unconstrained pass dispatches all-allow mask rows (the
+    identity operand), the constrained pass real DFA masks from a
+    bounded JSON schema, so the throughput delta is pure mask build +
+    apply cost. Parse rate over the constrained completions is the
+    correctness gate — the DFA guarantees 100%, anything less is a
+    masking bug, not a model quality question."""
+    import jax
+    import numpy as np
+
+    from runbooks_tpu.models.config import get_config
+    from runbooks_tpu.models.transformer import init_params
+    from runbooks_tpu.obs import device as obs_device
+    from runbooks_tpu.serve.engine import InferenceEngine, Request
+    from runbooks_tpu.train.data import ByteTokenizer
+
+    device = jax.devices()[0]
+    on_tpu = ("tpu" in jax.default_backend().lower()
+              or "TPU" in str(device))
+    model = os.environ.get("RBT_BENCH_MODEL",
+                           "bench-410m" if on_tpu else "debug")
+    slots = int(os.environ.get("RBT_BENCH_SLOTS", 4))
+    n_requests = int(os.environ.get("RBT_BENCH_REQUESTS", 8))
+    max_seq = int(os.environ.get("RBT_BENCH_MAXSEQ", 256))
+    prompt_len = int(os.environ.get("RBT_BENCH_PROMPT", 32))
+    max_tokens = int(os.environ.get("RBT_BENCH_MAXTOK", 64))
+
+    cfg = get_config(model, param_dtype="bfloat16")
+    if cfg.vocab_size < 258:          # ByteTokenizer eos id is 257
+        import dataclasses
+        cfg = dataclasses.replace(cfg, vocab_size=258)
+    params = jax.jit(lambda r: init_params(cfg, r))(jax.random.key(0))
+    tok = ByteTokenizer()
+    rng = np.random.default_rng(0)
+    # Byte-id prompts so the constrained rows decode as text the DFA
+    # walked; the model is random-init — content is irrelevant, the
+    # grammar owns the output language.
+    prompts = [rng.integers(32, 127, prompt_len).tolist()
+               for _ in range(n_requests)]
+    # Finite language (no stars): every path reaches the terminal state
+    # within max_tokens, so the 100% parse-rate gate is a theorem about
+    # the masking path, not a bet on sampling luck. An unbounded field
+    # (integer, string) would let temp-0.8 sampling pad until
+    # max_tokens and finish "length" — a workload bug, not a mask bug.
+    schema = {"type": "json_schema", "json_schema": {"schema": {
+        "type": "object",
+        "properties": {"verdict": {"type": "boolean"},
+                       "label": {"enum": ["low", "medium", "high"]},
+                       "score": {"enum": [0, 1, 2, 3]},
+                       "note": {"type": "null"}},
+        "required": ["verdict", "label", "score", "note"],
+        "additionalProperties": False}}}
+
+    engine = InferenceEngine(cfg, params, max_slots=slots,
+                             max_seq_len=max_seq, max_queue=n_requests,
+                             grammar="on", tokenizer=tok, seed=0)
+    engine.warmup()
+    unexpected_before = obs_device.SENTINEL.unexpected
+
+    def run(rf):
+        reqs = [Request(prompt_tokens=list(p), max_tokens=max_tokens,
+                        temperature=0.8, eos_id=tok.eos_id,
+                        response_format=rf) for p in prompts]
+        for r in reqs:
+            engine.submit(r)
+        t0 = time.perf_counter()
+        for _ in range(200000):
+            engine.step()
+            if all(r.finished for r in reqs):
+                break
+        else:
+            raise RuntimeError("grammar bench workload did not converge")
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.output_tokens) for r in reqs)
+        return reqs, toks / wall
+
+    _, plain_tps = run(None)                 # all-allow mask rows
+    creqs, grammar_tps = run(schema)         # real DFA masks
+
+    parsed = 0
+    for r in creqs:
+        text = bytes(t for t in r.output_tokens if t < 256).decode()
+        try:
+            if r.finish_reason == "grammar_complete":
+                json.loads(text)
+                parsed += 1
+        except ValueError:
+            pass
+    parse_rate = parsed / len(creqs)
+    unexpected = obs_device.SENTINEL.unexpected - unexpected_before
+    engine.release_steady()
+
+    ratio = grammar_tps / plain_tps
+    gate = 1.0 if (parse_rate == 1.0 and unexpected == 0) else 0.0
+    gs = engine.grammar_stats()
+    print(json.dumps({
+        "metric": f"{model} constrained vs unconstrained decode tok/s "
+                  f"({n_requests} reqs, {slots} slots, temp 0.8)",
+        "value": round(ratio, 3),
+        "unit": "x",
+        # Acceptance: constrained decode sustains >= 0.7x unconstrained
+        # (docs/structured-output.md cost model — one elementwise where
+        # per dispatch plus host-side mask gathers); forced to 0 on any
+        # parse failure or unexpected compile.
+        "vs_baseline": round(ratio / 0.7 * gate, 4),
+        "unconstrained_decode_tokens_per_sec": round(plain_tps, 1),
+        "constrained_decode_tokens_per_sec": round(grammar_tps, 1),
+        "parse_rate": parse_rate,
+        "grammar_cache": {k: gs[k] for k in
+                          ("hits", "misses", "compile_seconds_total")},
+        "constrained_requests": gs["requests_total"],
+        "draft_truncations": gs["draft_truncations_total"],
+        "unexpected_compiles_steady_loop": unexpected,
+        "platform": jax.default_backend(),
+        "device": str(device),
+    }))
+
+
 def inner() -> None:
     import jax
     import numpy as np
@@ -1112,8 +1246,11 @@ if __name__ == "__main__":
     lora_axis = os.environ.get("RBT_BENCH_LORA") == "1"
     mesh_axis = os.environ.get("RBT_BENCH_MESH_SERVE") == "1"
     kv_tier_axis = os.environ.get("RBT_BENCH_KV_TIER") == "1"
+    grammar_axis = os.environ.get("RBT_BENCH_GRAMMAR") == "1"
     if "--inner" in sys.argv:
-        if kv_tier_axis:
+        if grammar_axis:
+            grammar_inner()
+        elif kv_tier_axis:
             kv_tier_inner()
         elif mesh_axis:
             mesh_serve_inner()
@@ -1131,7 +1268,9 @@ if __name__ == "__main__":
         import benchkit
         benchkit.run_outer(
             os.path.abspath(__file__),
-            *(("KV swap-in TTFT vs recompute", "x") if kv_tier_axis
+            *(("constrained vs unconstrained decode", "x")
+              if grammar_axis
+              else ("KV swap-in TTFT vs recompute", "x") if kv_tier_axis
               else ("mesh serving max-fit vs single chip", "x")
               if mesh_axis
               else ("LoRA tenant density vs dedicated", "x") if lora_axis

@@ -183,6 +183,16 @@ run serve-mesh env RBT_BENCH_MESH_SERVE=1 RBT_BENCH_MESH_TENSOR=2 \
 #      overload run that never preempted).
 run serve-kv-tier env RBT_BENCH_KV_TIER=1 python bench_serve.py
 
+# 4a8. Grammar-constrained decoding (docs/structured-output.md): the
+#      same workload on ONE grammar-on engine, unconstrained (all-allow
+#      mask rows) then constrained by a bounded JSON schema — decode
+#      tok/s pair, 100% parse-rate gate over constrained completions,
+#      and the masked-program-variants-replace-plain-set compile gate
+#      (acceptance: constrained >= 0.7x unconstrained, vs_baseline =
+#      ratio/0.7, forced to 0 on any parse failure or unexpected
+#      compile).
+run serve-grammar env RBT_BENCH_GRAMMAR=1 python bench_serve.py
+
 # 4b. Observability instrumentation overhead (docs/observability.md):
 #     the per-step cost of the obs subsystem (spans + histogram observes +
 #     goodput update) as a percent of the real step time, PLUS the fleet-

@@ -401,6 +401,34 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         return paged_verify(params_, pool_, *rest, apool=apool_,
                             aslots=aslots_)
 
+    # Grammar-constrained decoding (serve/grammar.py,
+    # docs/structured-output.md): a grammar-on engine jits THESE shapes
+    # instead of the plain set — the gmask bool operand rides every
+    # dispatch (all-True rows for unconstrained lanes), so like the
+    # adapter variants above it replaces, never multiplies, the census.
+    vocab = cfg.vocab_size
+
+    def gmask_sds(*shape):
+        return _sds(shape, jnp.bool_)
+
+    def grammar_prefill(params_, pool_, gmask_, *rest):
+        return prefill(params_, pool_, *rest, gmask=gmask_)
+
+    def grammar_decode(params_, pool_, gmask_, *rest):
+        return decode(params_, pool_, *rest, gmask=gmask_)
+
+    def grammar_verify(params_, pool_, gmask_, *rest):
+        return verify(params_, pool_, *rest, gmask=gmask_)
+
+    def paged_grammar_prefill(params_, pool_, gmask_, *rest):
+        return paged_prefill(params_, pool_, *rest, gmask=gmask_)
+
+    def paged_grammar_decode(params_, pool_, gmask_, *rest):
+        return paged_decode(params_, pool_, *rest, gmask=gmask_)
+
+    def paged_grammar_verify(params_, pool_, gmask_, *rest):
+        return paged_verify(params_, pool_, *rest, gmask=gmask_)
+
     specs = [
         {"component": "serve", "name": "prefill", "fn": prefill,
          "args": prefill_args(rows_set[-1], buckets[-1]),
@@ -458,6 +486,36 @@ def _engine_specs(settings: AuditSettings) -> List[dict]:
         {"component": "serve", "name": "paged_adapter_verify",
          "fn": paged_adapter_verify,
          "args": ([params, paged_pool, apool, aslots_sds(slots)]
+                  + paged_verify_args[2:]),
+         "signatures": len(vp_buckets)},
+        {"component": "serve", "name": "grammar_prefill",
+         "fn": grammar_prefill,
+         "args": ([params, pool, gmask_sds(rows_set[-1], vocab)]
+                  + prefill_args(rows_set[-1], buckets[-1])[2:]),
+         "signatures": len(buckets) * len(rows_set)},
+        {"component": "serve", "name": "grammar_decode",
+         "fn": grammar_decode,
+         "args": ([params, pool, gmask_sds(slots, vocab)]
+                  + decode_args[2:]),
+         "signatures": len(views)},
+        {"component": "serve", "name": "grammar_verify",
+         "fn": grammar_verify,
+         "args": ([params, pool, gmask_sds(slots, K + 1, vocab)]
+                  + verify_args[2:]),
+         "signatures": len(views)},
+        {"component": "serve", "name": "paged_grammar_prefill",
+         "fn": paged_grammar_prefill,
+         "args": ([params, paged_pool, gmask_sds(slots, vocab)]
+                  + paged_prefill_args[2:]),
+         "signatures": len(pshapes) * len(rows_set)},
+        {"component": "serve", "name": "paged_grammar_decode",
+         "fn": paged_grammar_decode,
+         "args": ([params, paged_pool, gmask_sds(slots, vocab)]
+                  + paged_decode_args[2:]),
+         "signatures": len(vp_buckets)},
+        {"component": "serve", "name": "paged_grammar_verify",
+         "fn": paged_grammar_verify,
+         "args": ([params, paged_pool, gmask_sds(slots, K + 1, vocab)]
                   + paged_verify_args[2:]),
          "signatures": len(vp_buckets)},
     ]

@@ -90,6 +90,11 @@ ENUM_PARAMS = {
     # docs/speculative-decoding.md): a typo'd value would otherwise
     # silently serve without drafting.
     "speculative": ("off", "ngram"),
+    # Grammar-constrained structured output (serve/grammar.py,
+    # docs/structured-output.md): a typo'd value would otherwise 400
+    # every response_format request at the replica. One spelling — the
+    # name has no word boundary, like preemption.
+    "grammar": ("off", "on"),
     **{k: _ACCUM_ENUM for k in _ACCUM_KEYS},
     **{k: _CM_ENUM for k in _CM_KEYS},
 }
@@ -130,6 +135,13 @@ DEFAULT_NGRAM_MIN = 1
 _ADAPTER_POOL_KEYS = ("adapter_pool", "adapterPool", "adapterpool")
 _LORA_RANK_KEYS = ("lora_rank", "loraRank", "lorarank")
 _ADAPTER_DIR_KEYS = ("adapter_dir", "adapterDir", "adapterdir")
+
+# Grammar compile-cache capacity (serve/grammar.py GrammarCache,
+# docs/structured-output.md): LRU entries of compiled token DFAs. Only
+# meaningful with grammar: on — cross-checked in validate_params. Same
+# three-spelling convention as the other serving knobs.
+_GRAMMAR_CACHE_KEYS = ("grammar_cache_size", "grammarCacheSize",
+                       "grammarcachesize")
 
 # Host-RAM KV swap tier + per-class queue shares (serve/paging.py,
 # docs/paged-kv.md "Host tier and preemption"). kv_host_pages sizes the
@@ -180,6 +192,9 @@ INT_PARAMS = {
     **{k: 1 for k in _LORA_RANK_KEYS},
     # Host KV tier size: 0 is valid (no host tier — evictions drop).
     **{k: 0 for k in _KV_HOST_PAGES_KEYS},
+    # Grammar DFA compile cache: at least one entry (0 would evict every
+    # grammar on the next admission — a footgun, not a mode).
+    **{k: 1 for k in _GRAMMAR_CACHE_KEYS},
 }
 
 # Float-valued params the workloads float()-coerce at startup: key ->
@@ -401,6 +416,15 @@ def validate_params(params: dict) -> Optional[str]:
             and str(paging) != "paged":
         return ("spec.params.preemption: swap preempts at page "
                 "granularity; set kv_paging: paged (docs/paged-kv.md)")
+    # Grammar cross-field check (docs/structured-output.md): a cache-
+    # sizing knob without the mode serves nothing — same spec-typo shape
+    # as the pool-less LoRA knobs above.
+    if str(params.get("grammar") or "off") == "off":
+        knob_set = next((k for k in _GRAMMAR_CACHE_KEYS
+                         if params.get(k) is not None), None)
+        if knob_set is not None:
+            return (f"spec.params.{knob_set}: only applies with "
+                    "grammar: on (docs/structured-output.md)")
     # Mesh geometry (parallel/mesh.py): mesh_<axis> params select a
     # sharded engine. An unknown axis name is a typo the workload would
     # silently ignore (serving a single chip while the spec says eight);

@@ -152,6 +152,13 @@ CATALOG: Dict[str, str] = {
     "serve_adapter_hits_total": "counter",
     "serve_adapter_requests_total": "counter",
     "serve_adapters_resident": "gauge",
+    # Grammar-constrained structured output (serve/grammar.py,
+    # docs/structured-output.md): exported only when grammar is on
+    "serve_grammar_requests_total": "counter",
+    "serve_grammar_cache_hits_total": "counter",
+    "serve_grammar_cache_misses_total": "counter",
+    "serve_grammar_draft_truncations_total": "counter",
+    "serve_grammar_mask_build_seconds": "histogram",
     # Serving gateway (serve/gateway.py, docs/serving-dataplane.md):
     # the multi-replica routing data plane
     "gateway_requests_total": "counter",

@@ -23,8 +23,19 @@ def sample(
     top_k: jax.Array,               # [batch] int32; 0 => disabled
     top_p: jax.Array,               # [batch] float32; 1.0 => disabled
     max_top_k: int = 64,
+    gmask: jax.Array = None,        # [batch, vocab] bool; None/all-True => off
 ) -> jax.Array:
-    """Returns sampled token ids [batch]."""
+    """Returns sampled token ids [batch].
+
+    ``gmask`` is the grammar-constrained decoding operand
+    (serve/grammar.py): allowed-token bool rows applied as a -inf logit
+    mask BEFORE every path below, so greedy argmax, the static top-k
+    lane, and the full-vocab categorical all respect the constraint
+    identically. An all-True row is the identity — unconstrained lanes
+    batch with constrained ones in the same dispatch.
+    """
+    if gmask is not None:
+        logits = jnp.where(gmask, logits, -jnp.inf)
     vocab = logits.shape[-1]
     temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32),
                                    logits.shape[:1])
@@ -121,6 +132,7 @@ def speculative_verify(
     top_k: jax.Array,               # [batch] int32; 0 => disabled
     top_p: jax.Array,               # [batch] float32; 1.0 => disabled
     max_top_k: int = 64,
+    gmask: jax.Array = None,        # [batch, s, vocab] bool; None => off
 ):
     """Draft-verify verdicts for speculative decoding, distribution-exact
     w.r.t. ``sample``. ``logits[b, i]`` is the model's next-token
@@ -142,7 +154,16 @@ def speculative_verify(
     - ``full [b, s] int32``: an ordinary ``sample`` draw at every
       position — the bonus token after a fully accepted draft run, and
       the plain one-token decode for slots that proposed nothing.
+
+    ``gmask[b, i]`` constrains the distribution at verify position i
+    (grammar-constrained slots: the DFA state after consuming the draft
+    prefix ``drafts[b, :i]``). Applied to the logits up front, so the
+    accept/resid/full math below is exact w.r.t. the MASKED
+    distribution — the engine pre-truncates drafts to legal prefixes, so
+    every drafted token has nonzero mass under its row's mask.
     """
+    if gmask is not None:
+        logits = jnp.where(gmask, logits, -jnp.inf)
     b, s, vocab = logits.shape
     temperature = jnp.broadcast_to(
         jnp.asarray(temperature, jnp.float32), (b,))

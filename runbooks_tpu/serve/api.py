@@ -43,6 +43,17 @@ from runbooks_tpu.serve.engine import (
 from runbooks_tpu.train.data import load_tokenizer
 from runbooks_tpu.utils import contract
 
+# Top-level body fields /v1/completions understands (the chat endpoint
+# adds messages and the internal _chat marker before delegating).
+# Anything else 400s by name — constraint fields especially must never
+# fail open (a typo'd `response_format` silently serving unconstrained
+# text defeats the whole structured-output contract).
+_KNOWN_BODY_FIELDS = frozenset({
+    "prompt", "messages", "max_tokens", "temperature", "top_p", "top_k",
+    "timeout", "adapter", "priority", "stream", "response_format",
+    "model", "user", "_chat",
+})
+
 
 def _encode(tok, text: str) -> list:
     """One tokenize path for completions AND prefix registration — they
@@ -507,7 +518,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                   adapter_dir: Optional[str] = None,
                   kv_host_pages: int = 0,
                   preemption: str = "off",
-                  queue_shares: Optional[dict] = None) -> web.Application:
+                  queue_shares: Optional[dict] = None,
+                  grammar: str = "off",
+                  grammar_cache_size: Optional[int] = None,
+                  ) -> web.Application:
     """max_queue bounds the admission queue (full -> HTTP 429 with
     Retry-After); request_timeout_s is the default per-request wall-clock
     deadline (body field "timeout" overrides per request; expiry finishes
@@ -544,7 +558,16 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
     pressure (pages swap to host, the request re-queues with generated
     tokens intact). queue_shares maps priority class -> fraction of
     max_queue that class may occupy (admission 429s a class past its
-    share while others still fit)."""
+    share while others still fit).
+
+    grammar="on" turns on grammar-constrained structured output
+    (serve/grammar.py, docs/structured-output.md): request bodies may
+    carry `response_format` (a JSON-schema subset or raw EBNF), which
+    compiles host-side to a token-level DFA over this tokenizer's vocab
+    (LRU cache of grammar_cache_size entries keyed on grammar hash +
+    tokenizer fingerprint) and constrains sampling via a bool mask
+    operand — no per-grammar XLA compile. Constrained requests finish
+    with finish_reason "grammar_complete"."""
     if not request_timeout_s:
         # 0 disables, like the other *_s knobs — a validated config of 0
         # must mean "no deadline", not "400 every deadline-less request".
@@ -564,7 +587,8 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             adapter_pool=adapter_pool, lora_rank=lora_rank,
             adapter_dir=adapter_dir,
             kv_host_pages=kv_host_pages, preemption=preemption,
-            queue_shares=queue_shares)
+            queue_shares=queue_shares, grammar=grammar,
+            grammar_cache_size=grammar_cache_size, tokenizer=tokenizer)
     else:
         engine = InferenceEngine(cfg, model_params, max_slots=max_slots,
                                  max_seq_len=max_seq_len, mesh=mesh,
@@ -580,7 +604,10 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                                  lora_rank=lora_rank,
                                  adapter_dir=adapter_dir,
                                  preemption=preemption,
-                                 queue_shares=queue_shares)
+                                 queue_shares=queue_shares,
+                                 grammar=grammar,
+                                 grammar_cache_size=grammar_cache_size,
+                                 tokenizer=tokenizer)
     if warmup:
         # Pre-compile all buckets before readiness flips. warm_prefix
         # (params.json: warm_prefix) additionally compiles the prefix-KV
@@ -729,6 +756,33 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                             eng.spec_accepted,
                             help_text="Draft tokens verified-accepted "
                                       "by the batched verify forward.")
+        if eng.grammar != "off":
+            # Grammar-constrained structured output (serve/grammar.py,
+            # docs/structured-output.md): request volume, compile-cache
+            # economics, and spec-draft truncation — absolute mirrors of
+            # the engine's own counters at scrape time, like the spec
+            # family above. serve_grammar_mask_build_seconds (histogram)
+            # is observed by the engine as it builds mask operands.
+            gs = eng.grammar_stats()
+            reg.set_counter("serve_grammar_requests_total",
+                            gs["requests_total"],
+                            help_text="Requests admitted with a "
+                                      "response_format grammar "
+                                      "constraint.")
+            reg.set_counter("serve_grammar_cache_hits_total",
+                            gs["hits"],
+                            help_text="Grammar compiles served from the "
+                                      "token-DFA LRU cache.")
+            reg.set_counter("serve_grammar_cache_misses_total",
+                            gs["misses"],
+                            help_text="Grammar compiles that built a "
+                                      "fresh token DFA (host-side; "
+                                      "never an XLA compile).")
+            reg.set_counter("serve_grammar_draft_truncations_total",
+                            gs["draft_truncations_total"],
+                            help_text="Speculative drafts cut at the "
+                                      "first grammar-illegal token "
+                                      "before verify dispatch.")
         adapters = eng.adapter_stats()
         if adapters is not None:
             # Multi-tenant LoRA pool (serve/lora_pool.py,
@@ -931,6 +985,13 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
             # Adapter-pool residency/churn (docs/multi-tenant-lora.md);
             # None on pool-less engines.
             "adapters": worker.engine.adapter_stats(),
+            # Grammar-constrained decoding (docs/structured-output.md):
+            # DFA compile-cache economics + the vocab content hash that
+            # keys it. The fingerprint is exposed even with grammar off
+            # so a fleet audit can prove two replicas serve the same
+            # vocabulary before enabling constrained routing.
+            "grammar": worker.engine.grammar_stats(),
+            "tokenizer_fingerprint": worker.engine.tokenizer_fingerprint,
             "compiles": {"total": sentinel.total,
                          "unexpected": sentinel.unexpected,
                          "compile_seconds": round(
@@ -1011,6 +1072,19 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
         """Shared validation: body -> list[Request] or an error Response.
         default_priority is the X-Priority header value (the body field
         `priority` wins when both are set); None/absent -> standard."""
+        # Strict top-level field check: a typo'd constraint field (e.g.
+        # `respose_format`) must 400 with the offending names, never
+        # silently serve unconstrained output that the client then
+        # parses as schema-conforming. `model`/`user` pass through for
+        # OpenAI-client compatibility (accepted, unused).
+        unknown = sorted(set(body) - _KNOWN_BODY_FIELDS)
+        if unknown:
+            return None, web.json_response(
+                {"error": {"message": "unknown body field(s): "
+                                      + ", ".join(unknown),
+                           "type": "unknown_field",
+                           "fields": unknown}},
+                status=400)
         prompt = body.get("prompt")
         if prompt is None:
             return None, web.json_response(
@@ -1064,6 +1138,18 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                                       "interactive, standard, batch"}},
                 status=400)
         priority = priority.lower()
+        # Grammar-constrained output (docs/structured-output.md): the
+        # shape is validated here; the grammar itself compiles (or LRU-
+        # hits) at engine submit, where an unsupported construct raises
+        # GrammarError -> the existing ValueError -> 400 path with the
+        # offending JSON-pointer path in the message.
+        response_format = body.get("response_format")
+        if response_format is not None and not isinstance(response_format,
+                                                          dict):
+            return None, web.json_response(
+                {"error": {"message": "response_format must be an "
+                                      "object"}},
+                status=400)
 
         tok = app_["tokenizer"]
         eos = _eos_id(tok)
@@ -1073,7 +1159,7 @@ def create_server(cfg: ModelConfig, model_params, tokenizer=None,
                 prompt_tokens=_encode(tok, p), max_tokens=max_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos, deadline_s=deadline, adapter=adapter,
-                priority=priority))
+                priority=priority, response_format=response_format))
         return reqs, None
 
     async def _stream(app_, body, reqs, http_request, chat: bool = False,
@@ -1476,6 +1562,9 @@ def main() -> int:
     host_pages_raw = _param_any(params, "kv_host_pages", "kvHostPages",
                                 "kvhostpages")
     preemption_raw = params.get("preemption")
+    grammar_raw = params.get("grammar")
+    grammar_cache_raw = _param_any(params, "grammar_cache_size",
+                                   "grammarCacheSize", "grammarcachesize")
     # Per-class queue shares (queue_share_interactive: 0.5 etc.) fold
     # into the queue_shares dict the engine validates.
     queue_shares = {}
@@ -1540,7 +1629,14 @@ def main() -> int:
                        if host_pages_raw is not None else 0),
         preemption=(str(preemption_raw)
                     if preemption_raw is not None else "off"),
-        queue_shares=queue_shares or None)
+        queue_shares=queue_shares or None,
+        # Grammar-constrained structured output
+        # (docs/structured-output.md): `grammar: on` is the validated
+        # spelling (controller validate_params); the engine re-validates
+        # before warmup compiles anything.
+        grammar=(str(grammar_raw) if grammar_raw is not None else "off"),
+        grammar_cache_size=(int(grammar_cache_raw)
+                            if grammar_cache_raw is not None else None))
     port = int(params.get("port", contract.SERVE_PORT))
 
     # Graceful drain on SIGTERM (docs/fault-tolerance.md): run_app's

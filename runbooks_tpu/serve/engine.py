@@ -54,7 +54,7 @@ from runbooks_tpu.obs import metrics as obs_metrics
 from runbooks_tpu.obs.trace import complete as trace_complete
 from runbooks_tpu.obs.trace import record_enabled, span
 from runbooks_tpu.ops.sampling import sample, speculative_verify
-from runbooks_tpu.serve.speculative import NgramDraftIndex
+from runbooks_tpu.serve.speculative import NgramDraftIndex, legal_draft_prefix
 from runbooks_tpu.utils.hw import backend_tuning
 
 Params = Any
@@ -158,6 +158,11 @@ class Request:
     # preemption victims under page/slot pressure — batch work yields
     # to interactive work instead of degrading every tenant equally.
     priority: str = "standard"
+    # Grammar-constrained structured output (serve/grammar.py,
+    # docs/structured-output.md): {"type": "json_schema"|"ebnf", ...}.
+    # validate() compiles it (LRU-cached) into a token DFA and pins the
+    # per-request cursor below; None decodes unconstrained.
+    response_format: Optional[dict] = None
     # Filled by the engine:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
@@ -168,6 +173,10 @@ class Request:
     on_token: Optional[Callable[[int], None]] = None
     _slot: int = -1
     _adapter_lane: int = -1   # pool lane pinned at admission (-1 = base)
+    # Compiled DFA cursor (serve/grammar.GrammarCursor) when
+    # response_format is set: one int of decode state riding the request
+    # object, so preemption/swap-resume continues mid-grammar loss-free.
+    _grammar: Any = None
     # Preempted and re-queued (paged engine, preemption="swap"): the
     # request's generated-so-far tokens stay in output_tokens and its
     # written pages live on in the radix tree (HBM or host tier), so
@@ -234,11 +243,17 @@ def make_prefill_fn(cfg: ModelConfig, cache_len: int):
     EVERY dispatch): the stacked LoRA adapter pool and the per-row int32
     lane indices (-1 = base-only, the all-zero trash lane). A batch
     mixing tenants is one program; the lane values are operands
-    (docs/multi-tenant-lora.md)."""
+    (docs/multi-tenant-lora.md).
+
+    gmask (when given — engines with grammar: on pass it on EVERY
+    dispatch): [rows, vocab] bool allowed-token rows for the first
+    sampled token; all-True rows are the identity, so unconstrained
+    requests ride the same program (serve/grammar.py)."""
 
     def prefill_fn(params, pool, tokens, positions, slots,
                    last_pos, rng, temps, top_ks, top_ps,
-                   pk=None, pv=None, apool=None, aslots=None):
+                   pk=None, pv=None, apool=None, aslots=None,
+                   gmask=None):
         # Prefill `rows` requests into fresh zero rows at once, then
         # splice each row into the pool cache (donated => in-place, no
         # full-cache copy). Stale data from a slot's previous occupant
@@ -301,7 +316,8 @@ def make_prefill_fn(cfg: ModelConfig, cache_len: int):
         rng, sub = jax.random.split(rng)
         last_logits = jnp.take_along_axis(
             logits, last_pos[:, None, None], axis=1)[:, 0]
-        first = sample(last_logits, sub, temps, top_ks, top_ps)
+        first = sample(last_logits, sub, temps, top_ks, top_ps,
+                       gmask=gmask)
         new_pool = KVCache(k=new_k, v=new_v, index=pool.index,
                            k_scale=new_ks, v_scale=new_vs)
         return first, new_pool, rng
@@ -341,7 +357,12 @@ def make_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
 
     def decode_fn(params, cache, tokens, positions, rng,
                   temperature, top_k, top_p, eos_ids, remaining, active,
-                  apool=None, aslots=None):
+                  apool=None, aslots=None, gmask=None):
+        # gmask [B, vocab] is each slot's allowed-token row AT CHUNK
+        # START; it stays fixed across the scan, so it is exact only for
+        # the chunk's first step. The host takes exactly one token per
+        # chunk for constrained slots (_replay_chunk) — chunk=1 (the CPU
+        # default) degenerates to fully exact per-step masking.
         rng, step_rng = jax.random.split(rng)
         keys = jax.random.split(step_rng, chunk)
         adapters = None if apool is None else (apool, aslots)
@@ -352,7 +373,8 @@ def make_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
             logits, cache = forward(cfg, params, tok[:, None],
                                     positions=p[:, None], cache=cache,
                                     cache_view=view, adapters=adapters)
-            nxt = sample(logits[:, -1], key, temperature, top_k, top_p)
+            nxt = sample(logits[:, -1], key, temperature, top_k, top_p,
+                         gmask=gmask)
             nxt = jnp.where(alive, nxt, tok)
             out = (nxt, alive)
             emitted = emitted + alive
@@ -395,7 +417,7 @@ def make_verify_fn(cfg: ModelConfig, draft_tokens: int, pad_slot: int,
 
     def verify_fn(params, cache, tokens, positions, draft_len, rng,
                   temperature, top_k, top_p, active,
-                  apool=None, aslots=None):
+                  apool=None, aslots=None, gmask=None):
         offs = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
         live = active[:, None] & (offs <= draft_len[:, None])
         pos = jnp.where(live, positions[:, None] + offs, pad_slot)
@@ -405,7 +427,8 @@ def make_verify_fn(cfg: ModelConfig, draft_tokens: int, pad_slot: int,
                                 adapters=adapters)
         rng, sub = jax.random.split(rng)
         accept, resid, full = speculative_verify(
-            logits, tokens[:, 1:], sub, temperature, top_k, top_p)
+            logits, tokens[:, 1:], sub, temperature, top_k, top_p,
+            gmask=gmask)
         return accept, resid, full, cache, rng
 
     return verify_fn
@@ -436,7 +459,10 @@ class InferenceEngine:
                  lora_rank: Optional[int] = None,
                  adapter_dir: Optional[str] = None,
                  preemption: str = "off",
-                 queue_shares: Optional[dict] = None):
+                 queue_shares: Optional[dict] = None,
+                 grammar: str = "off",
+                 grammar_cache_size: Optional[int] = None,
+                 tokenizer=None):
         """mesh: optional jax.sharding.Mesh for sharded serving — params
         shard by the model's logical axes (tensor parallelism over heads/
         mlp, fsdp over embed) and the KV cache shards batch over data/fsdp
@@ -513,7 +539,20 @@ class InferenceEngine:
         class to ceil(share * max_queue) queued entries (share in
         (0, 1], default 1.0 per class) — a batch flood then sheds with
         429 before it can fill the whole queue against interactive
-        traffic."""
+        traffic.
+
+        grammar / grammar_cache_size / tokenizer: grammar-constrained
+        structured output (serve/grammar.py,
+        docs/structured-output.md). grammar: "on" compiles each
+        request's `response_format` (JSON-schema subset or EBNF) into a
+        token-level DFA — LRU-cached, grammar_cache_size entries
+        (default 64), keyed on (grammar hash, tokenizer fingerprint) —
+        and every dispatch then carries a [rows, vocab] bool
+        allowed-token mask operand (all-True rows for unconstrained
+        slots, so mixed traffic stays ONE program and warmup's masked
+        signatures are the steady-state ones). The tokenizer is needed
+        to map DFA bytes onto token ids; passing it with grammar: "off"
+        just exposes `tokenizer_fingerprint` (/debug/programs)."""
         self.cfg = cfg
         self.mesh = mesh
         self.prefill_budget = prefill_budget
@@ -652,6 +691,33 @@ class InferenceEngine:
         self._class_bounds = {
             cls: max(1, int(np.ceil(self.max_queue * s)))
             for cls, s in self.queue_shares.items()}
+        # Grammar-constrained decoding (serve/grammar.py): with
+        # grammar="on" every dispatch carries a gmask operand, so the
+        # masked program variants REPLACE the plain ones in the census
+        # (same discipline as the adapter pool's apool/aslots operands —
+        # variants never multiply the compiled set).
+        if grammar not in ("off", "on"):
+            raise ValueError(
+                f"grammar must be 'off' or 'on', got {grammar!r}")
+        self.grammar = grammar
+        self.tokenizer = tokenizer
+        self._token_vocab = None
+        self._grammar_cache = None
+        self.grammar_requests = 0          # compiled-constraint requests
+        self.grammar_completed = 0         # grammar_complete finishes
+        self.grammar_draft_truncations = 0  # drafts cut at illegal token
+        if grammar == "on":
+            from runbooks_tpu.serve.grammar import GrammarCache, TokenVocab
+
+            if tokenizer is None:
+                raise ValueError(
+                    "grammar: on needs the tokenizer (the DFA compiler "
+                    "maps grammar bytes onto token ids); pass tokenizer=")
+            self._token_vocab = TokenVocab.from_tokenizer(tokenizer)
+            self._grammar_cache = GrammarCache(
+                self._token_vocab, cfg.vocab_size,
+                capacity=(int(grammar_cache_size)
+                          if grammar_cache_size is not None else 64))
         self.deadline_expired = 0   # observability/tests
         self.preemptions = 0          # slots preempted (observability)
         self.preempted_resumed = 0    # preempted requests re-admitted
@@ -843,6 +909,113 @@ class InferenceEngine:
         return {"apool": self.adapters.tree,
                 "aslots": jnp.asarray(aslots)}
 
+    # -- grammar-constrained decoding (serve/grammar.py) ----------------
+    #
+    # Mask-operand builders, {} when grammar is off (the plain program
+    # set stays untouched — same shape as _adapter_kwargs). When on,
+    # EVERY dispatch passes a mask: all-True rows for unconstrained
+    # lanes, so the masked program variants are the only ones compiled.
+
+    @property
+    def tokenizer_fingerprint(self) -> Optional[str]:
+        """Stable vocab content hash (sha256 over id -> bytes), exposed
+        at /debug/programs and keying the grammar compile cache — a
+        model/tokenizer swap can never serve a stale mask."""
+        if self._token_vocab is None:
+            if self.tokenizer is None:
+                return None
+            from runbooks_tpu.serve.grammar import GrammarError, TokenVocab
+
+            try:
+                self._token_vocab = TokenVocab.from_tokenizer(self.tokenizer)
+            except GrammarError:
+                return None
+        return self._token_vocab.fingerprint
+
+    def _observe_mask_build(self, t0: float) -> None:
+        obs_metrics.REGISTRY.observe(
+            "serve_grammar_mask_build_seconds",
+            time.perf_counter() - t0,
+            buckets=_INTER_TOKEN_BUCKETS,
+            help_text="Host-side gmask operand build time per dispatch "
+                      "(grammar-constrained decoding).")
+
+    def _grammar_prefill_kwargs(self, group: List[tuple],
+                                rows: int) -> dict:
+        """[rows, vocab] first-token mask for one admission group.
+        Resumed (preempted) rows stay all-True: their prefill-sampled
+        token is discarded (_activate_slot), so masking it buys
+        nothing."""
+        if self._grammar_cache is None:
+            return {}
+        t0 = time.perf_counter()
+        mask = np.ones((rows, self.cfg.vocab_size), bool)
+        for i, (_, req) in enumerate(group):
+            if (req._grammar is not None
+                    and not (req._preempted and req.output_tokens)):
+                mask[i] = req._grammar.mask_row()
+        self._observe_mask_build(t0)
+        return {"gmask": jnp.asarray(mask)}
+
+    def _grammar_decode_kwargs(self) -> dict:
+        """[max_slots, vocab] per-slot allowed-token rows at the current
+        cursor states (all-True for unconstrained/inactive slots)."""
+        if self._grammar_cache is None:
+            return {}
+        t0 = time.perf_counter()
+        mask = np.ones((self.max_slots, self.cfg.vocab_size), bool)
+        for slot in range(self.max_slots):
+            req = self.slot_req[slot]
+            if self.active[slot] and req is not None \
+                    and req._grammar is not None:
+                mask[slot] = req._grammar.mask_row()
+        self._observe_mask_build(t0)
+        return {"gmask": jnp.asarray(mask)}
+
+    def _grammar_verify_kwargs(self, drafts: dict) -> dict:
+        """[max_slots, K+1, vocab] per-position verify masks: position 0
+        is the slot's current cursor state (the token after the carry-in);
+        position i the state after consuming the draft prefix d[:i].
+        Drafts were pre-truncated to legal prefixes (_collect_drafts), so
+        the non-mutating walk covers every drafted position; rows past a
+        slot's draft length stay all-True (their samples are parked and
+        never emitted)."""
+        if self._grammar_cache is None:
+            return {}
+        t0 = time.perf_counter()
+        K = self.draft_tokens
+        mask = np.ones((self.max_slots, K + 1, self.cfg.vocab_size), bool)
+        for slot, d in drafts.items():
+            req = self.slot_req[slot]
+            cur = None if req is None else req._grammar
+            if cur is None:
+                continue
+            states = [cur.state] + cur.walk(d)
+            for i, state in enumerate(states):
+                mask[slot, i] = cur.dfa.masks[state]
+        self._observe_mask_build(t0)
+        return {"gmask": jnp.asarray(mask)}
+
+    def _grammar_warm_kwargs(self, shape: tuple) -> dict:
+        """All-allow mask of the given shape for warmup dispatches, so
+        the gmask-live signatures are exactly the warmed ones."""
+        if self._grammar_cache is None:
+            return {}
+        return {"gmask": jnp.ones(shape, bool)}
+
+    def grammar_stats(self) -> dict:
+        """Grammar-mode snapshot (/debug/programs): compile-cache
+        hit/miss/size, compile seconds, and engine-side counters."""
+        out = {"mode": self.grammar}
+        if self._grammar_cache is None:
+            return out
+        out.update(self._grammar_cache.stats())
+        out.update({"requests_total": self.grammar_requests,
+                    "completed_total": self.grammar_completed,
+                    "draft_truncations_total":
+                        self.grammar_draft_truncations})
+        return out
+
     def _view_for(self, max_pos: int) -> int:
         """Smallest view bucket covering every query position this chunk
         can reach (caller passes max active length + chunk)."""
@@ -913,16 +1086,20 @@ class InferenceEngine:
                             jnp.zeros(r, jnp.float32),
                             jnp.zeros(r, jnp.int32),
                             jnp.ones(r, jnp.float32))
-                    akw = self._adapter_kwargs(np.full(r, -1, np.int32))
+                    kw = {**self._adapter_kwargs(np.full(r, -1, np.int32)),
+                          **self._grammar_warm_kwargs(
+                              (r, self.cfg.vocab_size))}
                     with self._mesh_ctx():
                         record_cost("prefill", f"b{bucket}r{r}",
                                     self._prefill, self.params,
-                                    self.cache, *args, **akw)
+                                    self.cache, *args, **kw)
                         _, self.cache, _ = self._prefill(
-                            self.params, self.cache, *args, **akw)
+                            self.params, self.cache, *args, **kw)
                     n_prefill += 1
             zeros = np.zeros(self.max_slots, np.int32)
-            akw = self._adapter_kwargs()
+            akw = {**self._adapter_kwargs(),
+                   **self._grammar_warm_kwargs(
+                       (self.max_slots, self.cfg.vocab_size))}
             for view in self.view_buckets:
                 args = (jnp.asarray(zeros),
                         jnp.asarray(np.full(self.max_slots, self._pad_slot,
@@ -944,6 +1121,10 @@ class InferenceEngine:
             if self.speculative != "off":
                 vtok = np.zeros((self.max_slots, self.draft_tokens + 1),
                                 np.int32)
+                akw = {**self._adapter_kwargs(),
+                       **self._grammar_warm_kwargs(
+                           (self.max_slots, self.draft_tokens + 1,
+                            self.cfg.vocab_size))}
                 for view in self.view_buckets:
                     args = (jnp.asarray(vtok), jnp.asarray(zeros),
                             jnp.asarray(zeros),
@@ -979,6 +1160,10 @@ class InferenceEngine:
                              if self.adapters is not None else 0),
             "lora_rank": (self.adapters.rank
                           if self.adapters is not None else None),
+            "grammar": self.grammar,
+            "grammar_cache_size": (self._grammar_cache.capacity
+                                   if self._grammar_cache is not None
+                                   else None),
             "compiles": sentinel.total - compiles_before,
             "compile_seconds": round(
                 sentinel.compile_seconds - seconds_before, 3),
@@ -1169,7 +1354,8 @@ class InferenceEngine:
                 self._commit_key(jax.random.key(0)),
                 jnp.zeros(rows, jnp.float32),
                 jnp.zeros(rows, jnp.int32), jnp.ones(rows, jnp.float32),
-                **self._adapter_kwargs(np.full(rows, -1, np.int32)))
+                **self._adapter_kwargs(np.full(rows, -1, np.int32)),
+                **self._grammar_warm_kwargs((rows, self.cfg.vocab_size)))
         return buffers
 
     def _find_prefix(self, prompt: List[int]):
@@ -1204,6 +1390,29 @@ class InferenceEngine:
             err = self.adapters.can_resolve(req.adapter)
             if err is not None:
                 raise ValueError(err)
+        if req.response_format is not None:
+            if self._grammar_cache is None:
+                raise ValueError(
+                    "this server has grammar-constrained decoding off "
+                    "(grammar: off); `response_format` needs grammar: on "
+                    "(docs/structured-output.md)")
+            # Compile (or LRU-hit) here, at the 400 boundary: a
+            # GrammarError names the unsupported construct and the
+            # request never enters the queue. The cursor pins the
+            # compiled DFA so cache eviction cannot strand the slot.
+            req._grammar = self._grammar_cache.cursor(req.response_format)
+            self.grammar_requests += 1
+            reg = obs_metrics.REGISTRY
+            reg.inc("serve_grammar_requests_total",
+                    help_text="Requests admitted with a compiled "
+                              "response_format constraint.")
+            st = self._grammar_cache.stats()
+            reg.set_counter("serve_grammar_cache_hits_total", st["hits"],
+                            help_text="Grammar DFA compile-cache hits.")
+            reg.set_counter("serve_grammar_cache_misses_total",
+                            st["misses"],
+                            help_text="Grammar DFA compile-cache misses "
+                                      "(each is one host-side compile).")
 
     def submit(self, req: Request) -> None:
         self.validate(req)
@@ -1485,7 +1694,8 @@ class InferenceEngine:
                 jnp.asarray(slots), jnp.asarray(last_pos), self.rng,
                 jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps))
-        akw = self._adapter_kwargs(aslots)
+        akw = {**self._adapter_kwargs(aslots),
+               **self._grammar_prefill_kwargs(group, rows)}
         # Dispatch timing is host-side, outside jit (the np.asarray pull
         # below is the device sync) — zero effect on compiled programs.
         t_dispatch = time.perf_counter()
@@ -1588,14 +1798,40 @@ class InferenceEngine:
         if req.on_token is not None:
             req.on_token(tok)
         hit_eos = req.eos_id is not None and tok == req.eos_id
+        # Grammar cursor advance — the single mutation point (draft
+        # gating and verify masks preview with the non-mutating walk).
+        # EOS is not a grammar token: the mask allows it exactly at
+        # accepting states, and it finishes via the normal "stop" path.
+        # A terminal state (accepting, no legal continuation) finishes
+        # the slot HERE — its empty mask row is never dispatched.
+        grammar_done = False
+        if req._grammar is not None and not hit_eos:
+            if not req._grammar.advance(tok):
+                # Masked sampling makes this unreachable; an assert
+                # would take the whole engine down for one request.
+                req.finished = True
+                req.finish_reason = "error"
+                self.active[slot] = False
+                self.slot_req[slot] = None
+                _observe_request_done(req, now)
+                self._on_slot_finished(slot, req)
+                return
+            grammar_done = req._grammar.at_terminal
         out_len = len(req.output_tokens)
         # lengths[slot] counts tokens written to the cache; the next decode
         # writes at position lengths[slot], which must stay < max_seq_len
         # (slot max_seq_len is the trash slot).
         out_of_room = self.lengths[slot] >= self.max_seq_len
-        if hit_eos or out_len >= req.max_tokens or out_of_room:
+        if hit_eos or grammar_done or out_len >= req.max_tokens \
+                or out_of_room:
             req.finished = True
-            req.finish_reason = "stop" if hit_eos else "length"
+            if hit_eos:
+                req.finish_reason = "stop"
+            elif grammar_done:
+                req.finish_reason = "grammar_complete"
+                self.grammar_completed += 1
+            else:
+                req.finish_reason = "length"
             self.active[slot] = False
             self.slot_req[slot] = None
             _observe_request_done(req, now)
@@ -1728,10 +1964,28 @@ class InferenceEngine:
         """Replay one decode chunk on the host: `valid[k]` is exactly the
         set of slots that were alive at device step k, so this loop lands
         in the same bookkeeping state as chunk=1 stepping would. Returns
-        tokens generated."""
+        tokens generated.
+
+        Grammar-constrained slots take only the chunk's FIRST token: the
+        gmask is exact for step 0 only (it cannot advance inside the
+        scan), so later steps may have sampled illegal tokens. Skipped
+        steps don't advance `lengths` — their KV sits past the cursor and
+        is rewritten by the next dispatch, the same stale-data invariant
+        speculative rollback rides. chunk=1 (the CPU default) makes this
+        a no-op; spec decode restores multi-token steps for constrained
+        slots. The device can't see a grammar_complete finish either, so
+        slots the host just finished skip the rest of their chunk."""
         generated = 0
+        taken: set = set()
         for k in range(toks.shape[0]):
             for slot in np.nonzero(valid[k])[0]:
+                if not self.active[slot]:
+                    continue  # finished host-side (grammar_complete)
+                req = self.slot_req[slot]
+                if req is not None and req._grammar is not None:
+                    if slot in taken:
+                        continue
+                    taken.add(slot)
                 generated += 1
                 self.lengths[slot] += 1
                 tok = int(toks[k, slot])
@@ -1788,7 +2042,23 @@ class InferenceEngine:
                       self.max_seq_len - 1 - int(self.lengths[slot]),
                       req.max_tokens - len(req.output_tokens) - 1)
             d = self._draft_for(slot, cap) if cap >= 1 else []
-            drafts[slot] = [int(t) for t in d[:max(cap, 0)]]
+            d = [int(t) for t in d[:max(cap, 0)]]
+            if req._grammar is not None and d:
+                # Cut the proposal at its first grammar-illegal token
+                # (and at a terminal accept state) BEFORE dispatch, so
+                # every drafted token has nonzero mass under its verify
+                # position's mask and speculative_verify's exact
+                # accept/reject math is untouched.
+                legal = legal_draft_prefix(req._grammar, d)
+                if len(legal) < len(d):
+                    self.grammar_draft_truncations += 1
+                    obs_metrics.REGISTRY.inc(
+                        "serve_grammar_draft_truncations_total",
+                        help_text="Speculative drafts truncated at a "
+                                  "grammar-illegal token before verify "
+                                  "dispatch.")
+                d = legal
+            drafts[slot] = d
             any_draft = any_draft or bool(drafts[slot])
         return drafts if any_draft else None
 
@@ -1815,7 +2085,8 @@ class InferenceEngine:
         step_drafted = int(draft_len.sum())
         t_dispatch = time.perf_counter()
         accept, resid, full = self._verify_dispatch(
-            tokens, positions, draft_len, temps, top_ks, top_ps)
+            tokens, positions, draft_len, temps, top_ks, top_ps,
+            self._grammar_verify_kwargs(drafts))
         wall = time.perf_counter() - t_dispatch
         generated = 0
         step_accepted = 0
@@ -1858,10 +2129,11 @@ class InferenceEngine:
         return generated
 
     def _verify_dispatch(self, tokens, positions, draft_len, temps,
-                         top_ks, top_ps):
+                         top_ks, top_ps, gkw=None):
         """Run the dense verify program at the smallest view bucket
         covering every position this step can write (L + K), returning
-        host verdict arrays."""
+        host verdict arrays. ``gkw`` is the grammar mask kwargs built by
+        the caller against this step's drafts ({} when grammar is off)."""
         view = self._view_for(int(self.lengths[self.active].max())
                               + self.draft_tokens + 1)
         t_dispatch = time.perf_counter()
@@ -1873,7 +2145,7 @@ class InferenceEngine:
                     jnp.asarray(positions), jnp.asarray(draft_len),
                     self.rng, jnp.asarray(temps), jnp.asarray(top_ks),
                     jnp.asarray(top_ps), jnp.asarray(self.active),
-                    **self._adapter_kwargs())
+                    **self._adapter_kwargs(), **(gkw or {}))
             # rbt-check: ignore[device-sync] verify dispatch boundary: one sync per verify step, not per token
             accept = np.asarray(accept)
             # rbt-check: ignore[device-sync] same boundary — resid rides the same verify sync
@@ -1930,7 +2202,8 @@ class InferenceEngine:
                 jnp.asarray(positions), self.rng,
                 jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
                 jnp.asarray(eos_ids), jnp.asarray(remaining),
-                jnp.asarray(self.active), **self._adapter_kwargs())
+                jnp.asarray(self.active), **self._adapter_kwargs(),
+                **self._grammar_decode_kwargs())
             # rbt-check: ignore[device-sync] decode-chunk dispatch boundary: one sync per chunk, not per token
             toks = np.asarray(toks)          # [chunk, slots]
             # rbt-check: ignore[device-sync] same boundary — valid rides the same chunk sync

@@ -710,6 +710,12 @@ def create_gateway(targets: Optional[Dict[str, str]] = None, *,
                 try:
                     timeout = ClientTimeout(total=remaining if remaining
                                             else 600)
+                    # The WHOLE body forwards verbatim — replica-side
+                    # fields like response_format (grammar-constrained
+                    # output, docs/structured-output.md) ride through
+                    # without the gateway learning their schema; the
+                    # replica owns validation (typed 400s proxy back
+                    # unchanged).
                     resp = await app["client"].post(
                         url + request.path, json=body, timeout=timeout,
                         headers=fwd_headers)

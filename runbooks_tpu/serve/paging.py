@@ -645,7 +645,7 @@ def make_paged_prefill_fn(cfg: ModelConfig, cache_len: int,
     def paged_prefill_fn(params, pool, tokens, positions, dest_pages,
                          last_pos, rng, temps, top_ks, top_ps,
                          prefix_pages=None, prefix_len=None,
-                         apool=None, aslots=None):
+                         apool=None, aslots=None, gmask=None):
         rows, _bucket = tokens.shape
         ad = cfg.activation_dtype
         quantized = pool.k.dtype == jnp.int8
@@ -718,7 +718,8 @@ def make_paged_prefill_fn(cfg: ModelConfig, cache_len: int,
         rng, sub = jax.random.split(rng)
         last_logits = jnp.take_along_axis(
             logits, last_pos[:, None, None], axis=1)[:, 0]
-        first = sample(last_logits, sub, temps, top_ks, top_ps)
+        first = sample(last_logits, sub, temps, top_ks, top_ps,
+                       gmask=gmask)
         new_pool = PagePool(
             k=flat_k.reshape(pool.k.shape),
             v=flat_v.reshape(pool.v.shape),
@@ -747,7 +748,10 @@ def make_paged_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
 
     def paged_decode_fn(params, pool, page_tables, tokens, positions, rng,
                         temperature, top_k, top_p, eos_ids, remaining,
-                        active, apool=None, aslots=None):
+                        active, apool=None, aslots=None, gmask=None):
+        # gmask [B, vocab]: chunk-start allowed-token rows, same
+        # first-step-exact contract as the dense decode (the host takes
+        # one token per chunk for constrained slots — _replay_chunk).
         B = tokens.shape[0]
         quantized = pool.k.dtype == jnp.int8
         flat_k = pool.k.reshape(L, n_flat, kvh, d)
@@ -779,7 +783,8 @@ def make_paged_decode_fn(cfg: ModelConfig, chunk: int, max_len: int,
             logits, cache = forward(cfg, params, tok[:, None],
                                     positions=p[:, None], cache=cache,
                                     adapters=adapters)
-            nxt = sample(logits[:, -1], key, temperature, top_k, top_p)
+            nxt = sample(logits[:, -1], key, temperature, top_k, top_p,
+                         gmask=gmask)
             nxt = jnp.where(alive, nxt, tok)
             # Write-back: the token the forward just wrote at p, view ->
             # physical page. Parked rows write the trash page. Shared
@@ -845,7 +850,7 @@ def make_paged_verify_fn(cfg: ModelConfig, draft_tokens: int,
 
     def paged_verify_fn(params, pool, page_tables, tokens, positions,
                         draft_len, rng, temperature, top_k, top_p,
-                        active, apool=None, aslots=None):
+                        active, apool=None, aslots=None, gmask=None):
         quantized = pool.k.dtype == jnp.int8
         flat_k = pool.k.reshape(L, n_flat, kvh, d)
         flat_v = pool.v.reshape(L, n_flat, kvh, d)
@@ -894,7 +899,8 @@ def make_paged_verify_fn(cfg: ModelConfig, draft_tokens: int,
             flat_vs = flat_vs.at[:, fi].set(wvs)
         rng, sub = jax.random.split(rng)
         accept, resid, full = speculative_verify(
-            logits, tokens[:, 1:], sub, temperature, top_k, top_p)
+            logits, tokens[:, 1:], sub, temperature, top_k, top_p,
+            gmask=gmask)
         new_pool = PagePool(
             k=flat_k.reshape(pool.k.shape),
             v=flat_v.reshape(pool.v.shape),
@@ -1431,7 +1437,10 @@ class PagedInferenceEngine(InferenceEngine):
                         args = args + (
                             jnp.full((r, ppb), trash, jnp.int32),
                             jnp.zeros(r, jnp.int32))
-                    akw = self._adapter_kwargs(np.full(r, -1, np.int32))
+                    akw = {**self._adapter_kwargs(np.full(r, -1,
+                                                          np.int32)),
+                           **self._grammar_warm_kwargs(
+                               (r, self.cfg.vocab_size))}
                     with self._mesh_ctx():
                         record_cost("paged_prefill",
                                     f"b{bucket}r{r}p{ppb}",
@@ -1443,7 +1452,9 @@ class PagedInferenceEngine(InferenceEngine):
             zeros = np.zeros(self.max_slots, np.int32)
             tables = np.full((self.max_slots, self.pages_per_slot), trash,
                              np.int32)
-            akw = self._adapter_kwargs()
+            akw = {**self._adapter_kwargs(),
+                   **self._grammar_warm_kwargs(
+                       (self.max_slots, self.cfg.vocab_size))}
             for vp in self.view_page_buckets:
                 args = (jnp.asarray(tables), jnp.asarray(zeros),
                         jnp.asarray(zeros),
@@ -1464,6 +1475,10 @@ class PagedInferenceEngine(InferenceEngine):
             if self.speculative != "off":
                 vtok = np.zeros((self.max_slots, self.draft_tokens + 1),
                                 np.int32)
+                akw = {**self._adapter_kwargs(),
+                       **self._grammar_warm_kwargs(
+                           (self.max_slots, self.draft_tokens + 1,
+                            self.cfg.vocab_size))}
                 for vp in self.view_page_buckets:
                     args = (jnp.asarray(tables), jnp.asarray(vtok),
                             jnp.asarray(zeros), jnp.asarray(zeros),
@@ -1519,6 +1534,10 @@ class PagedInferenceEngine(InferenceEngine):
                              if self.adapters is not None else 0),
             "lora_rank": (self.adapters.rank
                           if self.adapters is not None else None),
+            "grammar": self.grammar,
+            "grammar_cache_size": (self._grammar_cache.capacity
+                                   if self._grammar_cache is not None
+                                   else None),
             "compiles": sentinel.total - compiles_before,
             "compile_seconds": round(
                 sentinel.compile_seconds - seconds_before, 3),
@@ -1813,7 +1832,8 @@ class PagedInferenceEngine(InferenceEngine):
                 self._mesh_ctx():
             first, self.cache, self.rng = self._paged_prefill(
                 self.params, self.cache, *args,
-                **self._adapter_kwargs(aslots))
+                **self._adapter_kwargs(aslots),
+                **self._grammar_prefill_kwargs(group, rows))
             # rbt-check: ignore[device-sync] prefill dispatch boundary — the first token must reach the host to stream
             first = np.asarray(first)
         obs_metrics.REGISTRY.observe(
@@ -1843,10 +1863,11 @@ class PagedInferenceEngine(InferenceEngine):
     # -- decode --------------------------------------------------------
 
     def _verify_dispatch(self, tokens, positions, draft_len, temps,
-                         top_ks, top_ps):
+                         top_ks, top_ps, gkw=None):
         """Paged speculative verify: same verdict contract as the dense
         dispatch, against the gathered page view (page-table operand,
-        page-bucketed view sized to cover L + K writes)."""
+        page-bucketed view sized to cover L + K writes). ``gkw`` is the
+        caller-built grammar mask kwargs ({} when grammar is off)."""
         vp = self._view_pages_for(int(self.lengths[self.active].max())
                                   + self.draft_tokens + 1)
         t_dispatch = time.perf_counter()
@@ -1861,7 +1882,7 @@ class PagedInferenceEngine(InferenceEngine):
                     jnp.asarray(draft_len), self.rng,
                     jnp.asarray(temps), jnp.asarray(top_ks),
                     jnp.asarray(top_ps), jnp.asarray(self.active),
-                    **self._adapter_kwargs())
+                    **self._adapter_kwargs(), **(gkw or {}))
             # rbt-check: ignore[device-sync] verify dispatch boundary: one sync per verify step, not per token
             accept = np.asarray(accept)
             # rbt-check: ignore[device-sync] same boundary — resid rides the same verify sync
@@ -1898,7 +1919,7 @@ class PagedInferenceEngine(InferenceEngine):
                 self.rng, jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps), jnp.asarray(eos_ids),
                 jnp.asarray(remaining), jnp.asarray(self.active),
-                **self._adapter_kwargs())
+                **self._adapter_kwargs(), **self._grammar_decode_kwargs())
             # rbt-check: ignore[device-sync] decode-chunk dispatch boundary: one sync per chunk, not per token
             toks = np.asarray(toks)
             # rbt-check: ignore[device-sync] same boundary — valid rides the same chunk sync

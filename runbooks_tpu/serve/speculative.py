@@ -112,3 +112,23 @@ class NgramDraftIndex:
 
     def context_len(self, slot: int) -> int:
         return len(self._ctx[slot])
+
+
+def legal_draft_prefix(cursor, tokens: List[int]) -> List[int]:
+    """Grammar gate for a drafted continuation: the longest prefix of
+    ``tokens`` legal under the slot's DFA cursor (serve/grammar.py),
+    WITHOUT advancing it. The engine truncates here before dispatch so
+    ``speculative_verify``'s exact accept/reject math never sees a token
+    with zero mass under its position's mask — prompt-lookup drafts are
+    often schema-shaped already, so most survive whole. A draft that
+    crosses a terminal accept state is cut there too: the slot finishes
+    with ``grammar_complete`` and must not propose past it."""
+    if cursor is None or not tokens:
+        return tokens
+    states = cursor.walk(tokens)
+    keep = len(states)
+    for i, state in enumerate(states):
+        if cursor.dfa.terminal[state]:
+            keep = i + 1
+            break
+    return tokens[:keep]
